@@ -1,0 +1,436 @@
+"""The batched vectorized Paxos engine — the heart of the framework.
+
+This replaces the reference's object-per-group event machines
+(``PaxosInstanceStateMachine.java:117`` dispatching per-packet at 486-550,
+``PaxosAcceptor.java:59``, ``PaxosCoordinatorState.java:57``) with a single
+pure jitted transition over struct-of-array state for *all* G groups at once:
+
+  * Acceptor state (``PaxosAcceptor.java:82-103``: ``_slot``, ``ballotNum``,
+    ``ballotCoord``, accepted/committed maps) becomes int32 arrays ``[G]``
+    plus fixed ``[G, W]`` slot-ring windows (W = in-flight slot cap, the
+    ``SYNC_THRESHOLD``/out-of-order analog).
+  * Coordinator state (``PaxosCoordinatorState.java:68-143``: ballot,
+    prepare waitfor, myProposals slot map) becomes ``[G]`` phase/ballot
+    arrays plus a ``[G, W]`` proposal ring.
+  * Message passing (the reference's per-group NIO unicast/multicast of
+    PREPARE/ACCEPT/ACCEPT_REPLY/DECISION packets) becomes ONE exchange per
+    step of each replica's packed **state blob** — on real hardware an
+    ``all_gather`` over the 'replica' mesh axis (ICI); in host-simulation a
+    list of blobs with a ``heard`` mask for fault injection.
+
+Protocol formulation ("state-exchange Paxos"): each replica publishes an
+atomic snapshot (promised ballot, accepted window, learned decisions,
+coordinator proposals, prepare intent).  Every replica can then *locally*:
+
+  * promise: fold the max gathered prepare/proposal ballot into its own
+    (``PaxosAcceptor.handlePrepare``/``acceptAndUpdateBallot`` analog);
+  * accept: adopt the highest-ballot proposal per window lane
+    (phase-2a/2b collapse: publishing the accepted window IS the
+    accept-reply);
+  * learn: a slot is decided when >= majority of gathered windows show the
+    same (slot, ballot) accepted — every replica is a learner, so no
+    separate DECISION/COMMIT message is needed (the gathered windows double
+    as ``BatchedAcceptReply``+``BatchedCommit``);
+  * elect: prepare quorum = count of gathered promises at my ballot;
+    carryover = max-ballot accepted pvalue per lane among promisers' atomic
+    (ballot, window) snapshots — the ``handlePrepareReply`` carryover rule
+    (``PaxosInstanceStateMachine.java:945-975``).
+
+Safety notes (why time-skewed snapshots are sound): every (slot, ballot,
+value) shown in a window was genuinely accepted at some time; "a majority
+ever accepted (b, v) for slot s" is exactly the Paxos chosen-value
+condition, and the phase-1 carryover rule preserves it for higher ballots.
+Within one ballot only that ballot's unique coordinator proposes, so a
+majority at equal ballots implies equal values.
+
+Ring convention: window lane ``j`` always holds slot ``s`` with
+``s % W == j``.  All rings (accepted, decided, proposals) share it, so
+windows align lane-for-lane across replicas and the whole step is
+element-wise + [R]-axis reductions — no scatters, no dynamic shapes.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from .ballot import NULL, ballot_num, encode_ballot
+
+# Coordinator phases (``PaxosCoordinator`` null / PaxosCoordinatorState
+# preparing-vs-active distinction, ``PaxosCoordinatorState.java:68-143``).
+IDLE = 0
+PREPARING = 1
+ACTIVE = 2
+
+# Value-id space: NULL (-1) = empty lane; NOOP_VID (0) = hole-filling no-op
+# (not folded into app state); real request vids are > 0.  Bit 30 marks an
+# epoch-final stop request (``RequestPacket.stop``).
+NOOP_VID = 0
+STOP_BIT = 1 << 30
+
+_BIG = jnp.int32(2 ** 30)
+
+
+class EngineConfig(NamedTuple):
+    """Static engine shape (all python ints — closed over by jit)."""
+
+    n_groups: int          # G: group capacity (PINSTANCES_CAPACITY analog)
+    window: int = 16       # W: in-flight slots per group (ring size)
+    req_lanes: int = 8     # K: new client requests admitted per group per step
+    n_replicas: int = 3    # R: replica-axis size (mesh dim / gather width)
+
+
+class EngineState(NamedTuple):
+    """Per-replica engine state; every leaf int32 of shape [G] or [G, W]."""
+
+    # --- group metadata ---
+    member_mask: jnp.ndarray   # [G] bitmask of replica ids in the group (0 = inert)
+    majority: jnp.ndarray      # [G] popcount(member_mask)//2 + 1
+    version: jnp.ndarray       # [G] epoch number (reconfiguration)
+    stopped: jnp.ndarray       # [G] 1 after an epoch-final stop executed
+    # --- acceptor (ref: PaxosAcceptor.java:82-103) ---
+    bal: jnp.ndarray           # [G] promised ballot (packed)
+    exec_slot: jnp.ndarray     # [G] first un-executed slot (frontier)
+    acc_bal: jnp.ndarray       # [G, W] accepted ballot per lane
+    acc_vid: jnp.ndarray       # [G, W] accepted value id
+    acc_slot: jnp.ndarray      # [G, W] absolute slot of the lane (NULL empty)
+    # --- learner ---
+    dec_vid: jnp.ndarray       # [G, W] learned decision value
+    dec_slot: jnp.ndarray      # [G, W] learned decision slot (NULL empty)
+    app_hash: jnp.ndarray      # [G] device-side hash-chain of executed vids
+    n_execd: jnp.ndarray       # [G] total executed (== exec_slot minus noops... stats)
+    # --- coordinator (ref: PaxosCoordinatorState.java:68-143) ---
+    c_phase: jnp.ndarray       # [G] IDLE / PREPARING / ACTIVE
+    c_bal: jnp.ndarray         # [G] my coordinator ballot
+    c_next_slot: jnp.ndarray   # [G] next proposal slot to assign
+    c_prop_vid: jnp.ndarray    # [G, W] my outstanding proposals (value)
+    c_prop_slot: jnp.ndarray   # [G, W] my outstanding proposals (slot)
+
+
+class Blob(NamedTuple):
+    """What one replica publishes per step (the all_gather payload)."""
+
+    bal: jnp.ndarray         # [G]
+    exec_slot: jnp.ndarray   # [G]
+    acc_bal: jnp.ndarray     # [G, W]
+    acc_vid: jnp.ndarray     # [G, W]
+    acc_slot: jnp.ndarray    # [G, W]
+    dec_vid: jnp.ndarray     # [G, W]
+    dec_slot: jnp.ndarray    # [G, W]
+    prep_bal: jnp.ndarray    # [G]  my prepare intent (NULL if not PREPARING)
+    prop_bal: jnp.ndarray    # [G]  my active ballot (NULL if not ACTIVE)
+    prop_vid: jnp.ndarray    # [G, W]
+    prop_slot: jnp.ndarray   # [G, W]
+
+
+class StepOutputs(NamedTuple):
+    """Per-step results surfaced to the host."""
+
+    n_committed: jnp.ndarray   # [G] slots newly executed this step
+    exec_base: jnp.ndarray     # [G] frontier before this step's advance
+    exec_vid: jnp.ndarray      # [G, W] executed vids in slot order (NULL pad)
+    n_admitted: jnp.ndarray    # [G] client reqs consumed from req_vid lanes
+    maj_exec: jnp.ndarray      # [G] majority-rank execute frontier (GC mark)
+    app_hash: jnp.ndarray      # [G] post-step app hash (RSM invariant probe)
+
+
+def _i32(x):
+    return jnp.asarray(x, jnp.int32)
+
+
+def init_state(cfg: EngineConfig) -> EngineState:
+    """All groups inert (member_mask 0) — the MultiArrayMap-of-capacity analog."""
+    G, W = cfg.n_groups, cfg.window
+    g = lambda fill: jnp.full((G,), fill, jnp.int32)
+    gw = lambda fill: jnp.full((G, W), fill, jnp.int32)
+    return EngineState(
+        member_mask=g(0), majority=g(_BIG), version=g(0), stopped=g(0),
+        bal=g(NULL), exec_slot=g(0),
+        acc_bal=gw(NULL), acc_vid=gw(NULL), acc_slot=gw(NULL),
+        dec_vid=gw(NULL), dec_slot=gw(NULL),
+        app_hash=g(0), n_execd=g(0),
+        c_phase=g(IDLE), c_bal=g(NULL), c_next_slot=g(0),
+        c_prop_vid=gw(NULL), c_prop_slot=gw(NULL),
+    )
+
+
+def make_blob(state: EngineState) -> Blob:
+    """Atomic snapshot of what peers need; masked by coordinator phase."""
+    preparing = state.c_phase == PREPARING
+    active = state.c_phase == ACTIVE
+    act2 = active[:, None]
+    return Blob(
+        bal=state.bal,
+        exec_slot=state.exec_slot,
+        acc_bal=state.acc_bal,
+        acc_vid=state.acc_vid,
+        acc_slot=state.acc_slot,
+        dec_vid=state.dec_vid,
+        dec_slot=state.dec_slot,
+        prep_bal=jnp.where(preparing, state.c_bal, NULL),
+        prop_bal=jnp.where(active, state.c_bal, NULL),
+        prop_vid=jnp.where(act2, state.c_prop_vid, NULL),
+        prop_slot=jnp.where(act2, state.c_prop_slot, NULL),
+    )
+
+
+def _mix(h, vid):
+    """Deterministic app-hash fold (int32 wraparound is defined in XLA)."""
+    return (h * jnp.int32(31) + vid) ^ (vid << 7)
+
+
+def step(
+    state: EngineState,
+    g: Blob,                 # gathered blobs, every leaf with leading [R] axis
+    heard: jnp.ndarray,      # [R] bool — which peers' blobs are live
+    req_vid: jnp.ndarray,    # [G, K] new request value-ids (left-packed, NULL pad)
+    want_coord: jnp.ndarray, # [G] bool — host FD election trigger
+    my_id,                   # python int or traced scalar (replica-axis index)
+    cfg: EngineConfig,
+):
+    """One vectorized consensus step for all G groups. Pure function.
+
+    Returns (state', StepOutputs).  The caller journals the accepted-window
+    delta of state' *before* publishing blob(state') — that preserves the
+    reference's log-before-send rule (``AbstractPaxosLogger.logAndMessage``,
+    ``AbstractPaxosLogger.java:157``).
+    """
+    G, W, K, R = cfg.n_groups, cfg.window, cfg.req_lanes, cfg.n_replicas
+    my_id = _i32(my_id)
+    rids = jnp.arange(R, dtype=jnp.int32)
+    lanes = jnp.arange(W, dtype=jnp.int32)
+
+    # [R, G] — which gathered rows are valid senders for each group:
+    # heard and a member of the group (per-group replica subsets,
+    # ``groupMembers[]`` analog, PaxosInstanceStateMachine.java:176-188).
+    in_group = ((state.member_mask[None, :] >> rids[:, None]) & 1) == 1
+    live = heard[:, None] & in_group                      # [R, G]
+    live3 = live[:, :, None]                              # [R, G, 1]
+
+    inert = state.member_mask == 0
+    maj = state.majority
+
+    # ---- 1. promise update (handlePrepare / acceptAndUpdateBallot) ----
+    in_prep = jnp.where(live, g.prep_bal, NULL)
+    in_prop = jnp.where(live, g.prop_bal, NULL)
+    max_prop = in_prop.max(axis=0)                        # [G]
+    new_bal = jnp.maximum(state.bal, jnp.maximum(in_prep.max(axis=0), max_prop))
+
+    # ---- 2. accept (handleAccept, PaxosAcceptor.acceptAndUpdateBallot) ----
+    # Highest-ballot proposer wins; its ballot must equal the new promise.
+    r_star = jnp.argmax(in_prop, axis=0)                  # [G]
+    sel = lambda x: jnp.take_along_axis(x, r_star[None, :, None], axis=0)[0]
+    p_slot = sel(g.prop_slot)                             # [G, W]
+    p_vid = sel(g.prop_vid)
+    acc_ok = (max_prop == new_bal) & (max_prop != NULL) & (state.stopped == 0)
+    exec2 = state.exec_slot[:, None]
+    in_win = (
+        (p_slot >= exec2) & (p_slot < exec2 + W) & (p_vid != NULL)
+        & ((p_slot % W) == lanes[None, :])                # ring-residue sanity
+    )
+    do_acc = acc_ok[:, None] & in_win
+    acc_bal = jnp.where(do_acc, max_prop[:, None], state.acc_bal)
+    acc_vid = jnp.where(do_acc, p_vid, state.acc_vid)
+    acc_slot = jnp.where(do_acc, p_slot, state.acc_slot)
+
+    # ---- 3. learn (the BatchedAcceptReply->DECISION collapse) ----
+    ga_slot = jnp.where(live3, g.acc_slot, NULL)          # [R, G, W]
+    ga_bal = jnp.where(live3, g.acc_bal, NULL)
+    s_c = ga_slot.max(axis=0)                             # [G, W] newest slot per lane
+    match_s = (ga_slot == s_c[None]) & (s_c[None] != NULL) & live3
+    b_c = jnp.where(match_s, ga_bal, NULL).max(axis=0)    # [G, W]
+    match = match_s & (ga_bal == b_c[None])
+    n_match = match.sum(axis=0)                           # [G, W]
+    detected = (n_match >= maj[:, None]) & (s_c != NULL)
+    r_v = jnp.argmax(match, axis=0)                       # any matching row
+    det_vid = jnp.take_along_axis(g.acc_vid, r_v[None], axis=0)[0]
+
+    # Decision candidates per lane: keep the SMALLEST undecided-needed slot
+    # >= my frontier (so a lane never skips past an unexecuted decision).
+    def cand(slot, vid, valid):
+        ok = valid & (slot != NULL) & (slot >= exec2)
+        return jnp.where(ok, slot, _BIG), vid
+
+    c0_s, c0_v = cand(state.dec_slot, state.dec_vid, True)
+    gd_slot = jnp.where(live3, g.dec_slot, NULL)
+    gd_ok = (gd_slot != NULL) & (gd_slot >= exec2[None])
+    gd_s = jnp.where(gd_ok, gd_slot, _BIG)
+    r_d = jnp.argmin(gd_s, axis=0)
+    c1_s = jnp.take_along_axis(gd_s, r_d[None], axis=0)[0]
+    c1_v = jnp.take_along_axis(g.dec_vid, r_d[None], axis=0)[0]
+    c2_s, c2_v = cand(s_c, det_vid, detected)
+
+    best = jnp.minimum(jnp.minimum(c0_s, c1_s), c2_s)
+    have = best < _BIG
+    dec_vid = jnp.where(
+        have,
+        jnp.where(best == c0_s, c0_v, jnp.where(best == c1_s, c1_v, c2_v)),
+        state.dec_vid,
+    )
+    dec_slot = jnp.where(have, best, state.dec_slot)
+
+    # ---- 4. execute: advance the in-order frontier (EEC analog,
+    # PaxosInstanceStateMachine.extractExecuteAndCheckpoint:1511-1593) ----
+    slot_o = exec2 + lanes[None, :]                       # [G, W] frontier..+W
+    idx_o = slot_o % W
+    d_slot_at = jnp.take_along_axis(dec_slot, idx_o, axis=1)
+    d_vid_at = jnp.take_along_axis(dec_vid, idx_o, axis=1)
+    run = jnp.cumprod((d_slot_at == slot_o).astype(jnp.int32), axis=1)
+    n_adv = run.sum(axis=1)                               # [G]
+    exec_new = state.exec_slot + n_adv
+
+    h = state.app_hash
+    n_execd = state.n_execd
+    stop_seen = jnp.zeros((G,), bool)
+    for o in range(W):  # static unroll; W small
+        take = run[:, o] > 0
+        vid_o = d_vid_at[:, o]
+        real = take & (vid_o > 0)
+        h = jnp.where(real, _mix(h, vid_o), h)
+        n_execd = n_execd + real.astype(jnp.int32)
+        stop_seen = stop_seen | (take & ((vid_o & STOP_BIT) != 0))
+    stopped = jnp.maximum(state.stopped, stop_seen.astype(jnp.int32))
+
+    # Majority-rank execute frontier: the slot that >= majority of replicas
+    # have executed past (the medianCheckpointedSlot GC watermark analog,
+    # PValuePacket.medianCheckpointedSlot / nodeSlotNumbers piggybacking).
+    ge = jnp.where(live, g.exec_slot, NULL)
+    ge_sorted = -jnp.sort(-ge, axis=0)                    # descending [R, G]
+    maj_idx = jnp.clip(maj - 1, 0, R - 1)
+    maj_exec = jnp.take_along_axis(ge_sorted, maj_idx[None, :], axis=0)[0]
+    maj_exec = jnp.maximum(maj_exec, jnp.int32(0))
+
+    # ---- 5. coordinator ----
+    me_coord = state.c_bal
+    phase = state.c_phase
+    # Preempted by a strictly higher ballot in the system (-> resign,
+    # handlePrepareReply preemption, PaxosInstanceStateMachine.java:955-965).
+    preempt = (phase != IDLE) & (new_bal > me_coord)
+    phase = jnp.where(preempt, IDLE, phase)
+
+    # Election start (checkRunForCoordinator, :1962-2072): host FD says go.
+    start = want_coord & (phase == IDLE) & (~inert) & (stopped == 0)
+    start_bal = encode_ballot(ballot_num(new_bal) + 1, my_id)
+    c_bal = jnp.where(start, start_bal, me_coord)
+    phase = jnp.where(start, PREPARING, phase)
+    # Self-promise to my own prepare.
+    new_bal = jnp.where(phase == PREPARING, jnp.maximum(new_bal, c_bal), new_bal)
+
+    # Prepare quorum: peers whose published promise equals my ballot, +1 self.
+    not_me = rids != my_id
+    promised = (g.bal == c_bal[None, :]) & live & not_me[:, None]
+    n_promise = promised.sum(axis=0) + 1
+    quorum = (phase == PREPARING) & (n_promise >= maj)
+
+    # Carryover (the one genuinely sparse flow in the reference — here a
+    # lane-wise lexicographic max over promisers' atomic (ballot, window)
+    # snapshots, two-stage to stay in int32: max slot per lane first, then
+    # max ballot among rows showing that slot.  My own post-accept window
+    # joins as the self-promise row.
+    pa_ok = promised[:, :, None] & (ga_slot != NULL) & (ga_slot >= exec2[None])
+    my_ok = (acc_slot != NULL) & (acc_slot >= exec2)
+    all_ok = jnp.concatenate([pa_ok, my_ok[None]], axis=0)        # [R+1, G, W]
+    all_slot = jnp.where(all_ok, jnp.concatenate([g.acc_slot, acc_slot[None]], 0), NULL)
+    all_bal = jnp.where(all_ok, jnp.concatenate([g.acc_bal, acc_bal[None]], 0), NULL)
+    all_vid = jnp.concatenate([g.acc_vid, acc_vid[None]], axis=0)
+    co_slot = all_slot.max(axis=0)                                # [G, W]
+    at_max = all_ok & (all_slot == co_slot[None])
+    co_bal = jnp.where(at_max, all_bal, NULL).max(axis=0)
+    pick = at_max & (all_bal == co_bal[None])
+    best_r = jnp.argmax(pick, axis=0)
+    co_has = co_slot != NULL
+    co_vid = jnp.take_along_axis(all_vid, best_r[None], axis=0)[0]
+
+    won = quorum
+    phase = jnp.where(won, ACTIVE, phase)
+    # Safety bound for NEW proposals after an election: a promiser whose
+    # execute frontier passed slot s has executed a decision for s that may
+    # no longer appear in any window (its lane was reused).  So never invent
+    # proposals (hole no-ops / fresh requests) below the promise set's max
+    # frontier; those slots are learned via decision rings or sync instead.
+    # (Carryover re-proposals below it are safe: synod rules guarantee the
+    # carried value equals any chosen value.)
+    prom_exec = jnp.where(promised, g.exec_slot, NULL).max(axis=0)  # [G]
+    floor = jnp.maximum(exec_new, prom_exec)
+
+    # Adopt carryovers into my proposal ring on victory.
+    won2 = won[:, None]
+    c_prop_vid = jnp.where(won2, jnp.where(co_has, co_vid, NULL), state.c_prop_vid)
+    c_prop_slot = jnp.where(won2, jnp.where(co_has, co_slot, NULL), state.c_prop_slot)
+    max_co_slot = co_slot.max(axis=1)                             # [G] (NULL if none)
+    next_on_win = jnp.maximum(floor, max_co_slot + 1)
+    c_next = jnp.where(won, next_on_win, state.c_next_slot)
+
+    # Hole-filling no-ops: undecided slots in [floor, next) with no carryover
+    # must be proposed as no-ops to unblock the frontier.
+    exp_slot = exec_new[:, None] + ((lanes[None, :] - exec_new[:, None]) % W)
+    hole = (
+        won2 & (exp_slot >= floor[:, None]) & (exp_slot < c_next[:, None])
+        & (c_prop_slot != exp_slot) & (dec_slot != exp_slot)
+    )
+    c_prop_vid = jnp.where(hole, NOOP_VID, c_prop_vid)
+    c_prop_slot = jnp.where(hole, exp_slot, c_prop_slot)
+
+    # Retire proposals once their decision is learned (waitfor retirement,
+    # PaxosCoordinatorState myProposals) or they fell below the frontier.
+    is_active = phase == ACTIVE
+    dec_at_prop = dec_slot == c_prop_slot                 # lane-aligned
+    retire = (c_prop_slot != NULL) & (dec_at_prop | (c_prop_slot < exec2))
+    c_prop_vid = jnp.where(retire, NULL, c_prop_vid)
+    c_prop_slot = jnp.where(retire, NULL, c_prop_slot)
+
+    # Stop-request ordering (proposeStop semantics, PaxosManager.java:1269-
+    # 1390): once a stop is proposed or decided, admit nothing more.
+    stopping = ((c_prop_vid != NULL) & ((c_prop_vid & STOP_BIT) != 0)).any(axis=1)
+    dec_stop = (
+        (dec_slot != NULL) & (dec_slot >= exec2) & ((dec_vid & STOP_BIT) != 0)
+    ).any(axis=1)
+    may_admit = is_active & (stopped == 0) & (~stopping) & (~dec_stop)
+    # ...and within this step's batch, nothing after a stop lane.
+    req_stop = (req_vid != NULL) & ((req_vid & STOP_BIT) != 0)
+    no_stop_before = jnp.cumprod(1 - req_stop.astype(jnp.int32), axis=1)
+    no_stop_before = jnp.concatenate(
+        [jnp.ones((G, 1), jnp.int32), no_stop_before[:, :-1]], axis=1
+    )
+
+    # Admit new client requests: consecutive slots from c_next, bounded by
+    # the majority window (don't outrun a majority's rings) and free lanes.
+    ks = jnp.arange(K, dtype=jnp.int32)
+    bound = maj_exec + W
+    cand_slot_k = c_next[:, None] + ks[None, :]           # [G, K]
+    cand_lane = cand_slot_k % W
+    lane_busy = jnp.take_along_axis(c_prop_slot != NULL, cand_lane, axis=1)
+    can_k = (
+        may_admit[:, None] & (no_stop_before > 0)
+        & (req_vid != NULL) & (cand_slot_k < bound[:, None]) & (~lane_busy)
+    )
+    admit = jnp.cumprod(can_k.astype(jnp.int32), axis=1)  # contiguous prefix
+    n_admit = admit.sum(axis=1)                           # [G]
+    onehot = (cand_lane[:, :, None] == lanes[None, None, :]) & (admit[:, :, None] > 0)
+    add_vid = jnp.where(onehot, req_vid[:, :, None], 0).sum(axis=1)
+    add_slot = jnp.where(onehot, cand_slot_k[:, :, None], 0).sum(axis=1)
+    newly = onehot.any(axis=1)
+    c_prop_vid = jnp.where(newly, add_vid, c_prop_vid)
+    c_prop_slot = jnp.where(newly, add_slot, c_prop_slot)
+    c_next = c_next + n_admit
+
+    new_state = EngineState(
+        member_mask=state.member_mask, majority=state.majority,
+        version=state.version, stopped=stopped,
+        bal=new_bal, exec_slot=exec_new,
+        acc_bal=acc_bal, acc_vid=acc_vid, acc_slot=acc_slot,
+        dec_vid=dec_vid, dec_slot=dec_slot,
+        app_hash=h, n_execd=n_execd,
+        c_phase=phase, c_bal=c_bal, c_next_slot=c_next,
+        c_prop_vid=c_prop_vid, c_prop_slot=c_prop_slot,
+    )
+    outputs = StepOutputs(
+        n_committed=n_adv,
+        exec_base=state.exec_slot,
+        exec_vid=jnp.where(run > 0, d_vid_at, NULL),
+        n_admitted=n_admit,
+        maj_exec=maj_exec,
+        app_hash=h,
+    )
+    return new_state, outputs

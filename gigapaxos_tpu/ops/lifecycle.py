@@ -1,0 +1,116 @@
+"""Group lifecycle array ops: batched create / kill / pause-extract / restore.
+
+The reference creates one ``PaxosInstanceStateMachine`` object per group
+(``PaxosManager.createPaxosInstance``, ``PaxosManager.java:611-810``) and
+pauses idle ones to disk via ``HotRestoreInfo`` (``paxosutil/
+HotRestoreInfo.java:31-60``, ``PaxosManager.java:2264-2392``).  Here a group
+is a *row* of the engine arrays, so create/kill/pause are batched scatter /
+gather updates on :class:`~gigapaxos_tpu.ops.engine.EngineState`.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .ballot import NULL, encode_ballot
+from .engine import ACTIVE, IDLE, EngineState
+
+
+def _popcount16(x: jnp.ndarray) -> jnp.ndarray:
+    """Popcount for small masks (MAX_GROUP_SIZE=16 => <= 16 bits)."""
+    c = jnp.zeros_like(x)
+    for b in range(16):
+        c = c + ((x >> b) & 1)
+    return c
+
+
+def initial_coordinator(idx: np.ndarray, member_mask: np.ndarray) -> np.ndarray:
+    """Deterministic initial coordinator: round-robin by group index over the
+    member set (the ``roundRobinCoordinator`` hash-offset rule,
+    ``PaxosInstanceStateMachine.java:2123`` — spreads leadership).
+    Pure numpy (host-side, used at create time by every replica identically).
+    """
+    idx = np.asarray(idx)
+    member_mask = np.asarray(member_mask)
+    out = np.zeros_like(idx)
+    for row, (g, mask) in enumerate(zip(idx, member_mask)):
+        members = [r for r in range(32) if (int(mask) >> r) & 1]
+        out[row] = members[int(g) % len(members)] if members else 0
+    return out
+
+
+def create_groups(
+    state: EngineState,
+    idx: jnp.ndarray,          # [N] group indices to (re)create
+    member_mask: jnp.ndarray,  # [N] replica-id bitmasks
+    coord0: jnp.ndarray,       # [N] initial coordinator replica id
+    my_id: int,
+    version: jnp.ndarray | int = 0,
+) -> EngineState:
+    """Batched group creation.  All replicas run this identically, so the
+    initial ballot (0, coord0) is implicitly promised everywhere — the
+    initial coordinator starts ACTIVE with no prepare phase, matching the
+    reference's initial-ballot shortcut."""
+    idx = jnp.asarray(idx, jnp.int32)
+    member_mask = jnp.asarray(member_mask, jnp.int32)
+    coord0 = jnp.asarray(coord0, jnp.int32)
+    n = idx.shape[0]
+    version = jnp.broadcast_to(jnp.asarray(version, jnp.int32), (n,))
+    bal0 = encode_ballot(jnp.zeros((n,), jnp.int32), coord0)
+    i_am_coord = coord0 == my_id
+    W = state.acc_bal.shape[1]
+    nullw = jnp.full((n, W), NULL, jnp.int32)
+    zeros = jnp.zeros((n,), jnp.int32)
+    return state._replace(
+        member_mask=state.member_mask.at[idx].set(member_mask),
+        majority=state.majority.at[idx].set(_popcount16(member_mask) // 2 + 1),
+        version=state.version.at[idx].set(version),
+        stopped=state.stopped.at[idx].set(0),
+        bal=state.bal.at[idx].set(bal0),
+        exec_slot=state.exec_slot.at[idx].set(0),
+        acc_bal=state.acc_bal.at[idx].set(nullw),
+        acc_vid=state.acc_vid.at[idx].set(nullw),
+        acc_slot=state.acc_slot.at[idx].set(nullw),
+        dec_vid=state.dec_vid.at[idx].set(nullw),
+        dec_slot=state.dec_slot.at[idx].set(nullw),
+        app_hash=state.app_hash.at[idx].set(0),
+        n_execd=state.n_execd.at[idx].set(0),
+        c_phase=state.c_phase.at[idx].set(
+            jnp.where(i_am_coord, ACTIVE, IDLE).astype(jnp.int32)
+        ),
+        c_bal=state.c_bal.at[idx].set(jnp.where(i_am_coord, bal0, NULL)),
+        c_next_slot=state.c_next_slot.at[idx].set(zeros),
+        c_prop_vid=state.c_prop_vid.at[idx].set(nullw),
+        c_prop_slot=state.c_prop_slot.at[idx].set(nullw),
+    )
+
+
+def kill_groups(state: EngineState, idx: jnp.ndarray) -> EngineState:
+    """Batched kill: rows become inert (the Cremator analog,
+    ``PaxosManager.java:2142-2205``)."""
+    idx = jnp.asarray(idx, jnp.int32)
+    n = idx.shape[0]
+    big = jnp.full((n,), 2 ** 30, jnp.int32)
+    return state._replace(
+        member_mask=state.member_mask.at[idx].set(0),
+        majority=state.majority.at[idx].set(big),
+        stopped=state.stopped.at[idx].set(0),
+        bal=state.bal.at[idx].set(NULL),
+        c_phase=state.c_phase.at[idx].set(IDLE),
+        c_bal=state.c_bal.at[idx].set(NULL),
+    )
+
+
+def extract_rows(state: EngineState, idx) -> Tuple:
+    """Gather full rows for pause-to-disk (HotRestoreInfo analog)."""
+    idx = jnp.asarray(idx, jnp.int32)
+    return tuple(leaf[idx] for leaf in state)
+
+
+def restore_rows(state: EngineState, idx, rows: Tuple) -> EngineState:
+    """Scatter previously extracted rows back (unpause)."""
+    idx = jnp.asarray(idx, jnp.int32)
+    return EngineState(*(leaf.at[idx].set(row) for leaf, row in zip(state, rows)))

@@ -1,0 +1,10 @@
+from .mesh import make_mesh, pick_mesh_shape
+from .spmd import spmd_step, single_chip_step, stack_states
+
+__all__ = [
+    "make_mesh",
+    "pick_mesh_shape",
+    "spmd_step",
+    "single_chip_step",
+    "stack_states",
+]

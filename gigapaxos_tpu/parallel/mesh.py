@@ -1,0 +1,46 @@
+"""Device mesh construction for the consensus engine.
+
+The replica axis ('r') is the TPU-native replacement for the reference's
+NIO multicast between group members (``nio/NIOTransport.java:115`` et al.,
+SURVEY.md §2.3): PREPARE/ACCEPT/ACCEPT_REPLY/COMMIT traffic rides one
+``all_gather`` per step over ICI.  The group axis ('g') shards the
+million-group state arrays — groups are fully independent, so 'g' needs no
+collectives at all (the "group-parallelism" axis of SURVEY.md §2.8).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+REPLICA_AXIS = "r"
+GROUP_AXIS = "g"
+
+
+def pick_mesh_shape(n_devices: int, n_replicas: Optional[int] = None) -> Tuple[int, int]:
+    """Choose (group_shards, replicas): replica axis 3 when it divides the
+    device count (the BASELINE v5e 3-acceptor layout), else 2, else 1."""
+    if n_replicas is None:
+        for r in (3, 2, 1):
+            if n_devices % r == 0:
+                n_replicas = r
+                break
+    if n_devices % n_replicas:
+        raise ValueError(f"{n_replicas} replicas don't divide {n_devices} devices")
+    return n_devices // n_replicas, n_replicas
+
+
+def make_mesh(
+    n_replicas: int,
+    n_group_shards: int = 1,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    devices = jax.devices() if devices is None else list(devices)
+    need = n_replicas * n_group_shards
+    if len(devices) < need:
+        raise ValueError(f"need {need} devices, have {len(devices)}")
+    arr = np.array(devices[:need]).reshape(n_group_shards, n_replicas)
+    return Mesh(arr, (GROUP_AXIS, REPLICA_AXIS))

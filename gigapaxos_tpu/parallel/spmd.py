@@ -1,0 +1,115 @@
+"""SPMD wrappers for the consensus step.
+
+Two execution modes over the same pure :func:`gigapaxos_tpu.ops.engine.step`:
+
+* :func:`spmd_step` — shard_map over a ``(g, r)`` mesh: each replica chip
+  holds its own engine state shard; the blob exchange is a single
+  ``lax.all_gather`` over the replica axis (ICI).  This is the real
+  multi-chip deployment shape (BASELINE.json: 3 chips as acceptors) and
+  what the driver's ``dryrun_multichip`` exercises.
+
+* :func:`single_chip_step` — all R replica states stacked on one device and
+  advanced with ``vmap``; the "gather" is just the stacked blobs.  This is
+  the loopback/bench mode on a single TPU chip (the analog of the
+  reference's N-nodes-in-one-JVM testing mode, ``PaxosManager.java:108-111``).
+
+Global array convention for SPMD: every state leaf gets a leading replica
+axis -> ``[R, G, ...]`` sharded ``P('r', 'g')``; inputs likewise.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..ops.engine import EngineConfig, EngineState, StepOutputs, make_blob, step
+from .mesh import GROUP_AXIS, REPLICA_AXIS
+
+
+def stack_states(states: List[EngineState]) -> EngineState:
+    """Stack per-replica states into the [R, ...] global layout."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+
+
+def single_chip_step(cfg: EngineConfig):
+    """vmap-over-replicas step on one device.
+
+    Takes (states [R,...], req_vid [R,G,K], want_coord [R,G]) and returns
+    (states', outputs [R,...]).
+    """
+    R = cfg.n_replicas
+    heard = jnp.ones((R,), bool)
+    my_ids = jnp.arange(R, dtype=jnp.int32)
+
+    def _one(state, gathered, req, want, my_id):
+        return step(state, gathered, heard, req, want, my_id, cfg)
+
+    @jax.jit
+    def run(states, req_vid, want_coord):
+        blobs = jax.vmap(make_blob)(states)
+        return jax.vmap(_one, in_axes=(0, None, 0, 0, 0))(
+            states, blobs, req_vid, want_coord, my_ids
+        )
+
+    return run
+
+
+def spmd_step(cfg: EngineConfig, mesh: Mesh):
+    """shard_map step over the (g, r) mesh.
+
+    Global args: states [R, G, ...] with P('r', 'g'); req_vid [R, G, K];
+    want_coord [R, G].  Each shard holds [1, G/gs, ...]; the replica-axis
+    blob exchange is one all_gather per step on ICI.
+    """
+    R = cfg.n_replicas
+    rg = P(REPLICA_AXIS, GROUP_AXIS)
+    state_spec = EngineState(*([rg] * len(EngineState._fields)))
+    out_spec = StepOutputs(*([rg] * len(StepOutputs._fields)))
+
+    n_shards = mesh.shape[GROUP_AXIS]
+    if cfg.n_groups % n_shards:
+        raise ValueError("n_groups must divide evenly over the group axis")
+    local_cfg = cfg._replace(n_groups=cfg.n_groups // n_shards)
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            state_spec,
+            P(REPLICA_AXIS, GROUP_AXIS, None),
+            P(REPLICA_AXIS, GROUP_AXIS),
+        ),
+        out_specs=(state_spec, out_spec),
+        check_rep=False,
+    )
+    def _sharded(states, req_vid, want_coord):
+        # local shapes: leaves [1, G_loc, ...]
+        state = jax.tree.map(lambda x: x[0], states)
+        blob = make_blob(state)
+        gathered = jax.tree.map(lambda x: lax.all_gather(x, REPLICA_AXIS), blob)
+        heard = jnp.ones((R,), bool)
+        my_id = lax.axis_index(REPLICA_AXIS).astype(jnp.int32)
+        new_state, out = step(
+            state, gathered, heard, req_vid[0], want_coord[0], my_id, local_cfg
+        )
+        expand = lambda x: x[None]
+        return jax.tree.map(expand, new_state), jax.tree.map(expand, out)
+
+    return jax.jit(_sharded)
+
+
+def replicate_inputs(mesh: Mesh, states: EngineState, req_vid, want_coord):
+    """Device_put global inputs with the canonical shardings."""
+    sh = lambda spec: NamedSharding(mesh, spec)
+    states = jax.tree.map(
+        lambda x: jax.device_put(x, sh(P(REPLICA_AXIS, GROUP_AXIS))), states
+    )
+    req_vid = jax.device_put(req_vid, sh(P(REPLICA_AXIS, GROUP_AXIS, None)))
+    want_coord = jax.device_put(want_coord, sh(P(REPLICA_AXIS, GROUP_AXIS)))
+    return states, req_vid, want_coord

@@ -1,0 +1,71 @@
+"""Core engine flags — the PaxosConfig analog.
+
+Re-creation of the reference's ``PaxosConfig.PC`` flag enum
+(``src/edu/umass/cs/gigapaxos/PaxosConfig.java:214-967``), keeping the
+reference's names and defaults where the concept survives, plus new
+TPU-engine knobs (group capacity padding, slot-window size, mesh shape).
+Register with :class:`gigapaxos_tpu.utils.Config` and read via
+``Config.get(PC.FLAG)``.
+"""
+
+from __future__ import annotations
+
+import enum
+
+from .utils.config import Config
+
+
+class PC(enum.Enum):
+    # ---- scale envelope (ref: PaxosConfig.java:263,532,537,403) -------
+    PINSTANCES_CAPACITY = 2 ** 21        # max in-memory paxos groups (2M ref parity)
+    MAX_GROUP_SIZE = 16                  # max replicas per group
+    MAX_OUTSTANDING_REQUESTS = 8000
+    MAX_BATCH_SIZE = 2000                # client requests coalesced per proposal batch
+
+    # ---- TPU engine shape (new; no reference counterpart) -------------
+    SLOT_WINDOW = 16                     # W: in-flight slots per group (ring buffer)
+    DEFAULT_NUM_REPLICAS = 3
+    GROUP_BLOCK = 1024                   # group-count padding quantum (lane friendliness)
+    ENGINE_DTYPE = "int32"
+
+    # ---- batching (ref: RequestBatcher / PaxosPacketBatcher) ----------
+    BATCHING_ENABLED = True
+    BATCH_SLEEP_MS = 0.2                 # adaptive batcher base sleep
+    MIN_PP_BATCH_SIZE = 3
+
+    # ---- durability (ref: PaxosConfig.java:240,314,334,410) -----------
+    ENABLE_JOURNALING = True
+    SYNC_JOURNAL = False                 # fsync every journal batch
+    MAX_LOG_FILE_SIZE = 64 * 1024 * 1024
+    MAX_LOG_MESSAGE_SIZE = 5 * 1024 * 1024
+    CHECKPOINT_INTERVAL = 400            # slots between app checkpoints
+    JOURNAL_GC_FREQUENCY = 100
+    PAXOS_LOGS_DIR = "paxos_logs"
+
+    # ---- liveness (ref: PaxosConfig.java:668; FailureDetection.java:62-79)
+    FAILURE_DETECTION_TIMEOUT_S = 6.0
+    PING_PERIOD_S = 3.0                  # = timeout / 2
+    COORDINATOR_LONG_DEAD_FACTOR = 3.0   # long-dead at 3x timeout
+    SYNC_THRESHOLD = 32                  # missing decisions before sync kicks in
+    MAX_SYNC_DECISIONS_GAP = 1 << 14
+
+    # ---- pause / residency (ref: PaxosConfig.java:277,291) ------------
+    PAUSE_OPTION = True
+    DEACTIVATION_PERIOD_S = 60.0
+    PAUSE_BATCH_SIZE = 1000
+
+    # ---- request handling ---------------------------------------------
+    REQUEST_TIMEOUT_S = 8.0              # client callback GC (ref: PaxosClientAsync 8s)
+    RESPONSE_CACHE_SIZE = 1 << 16        # exactly-once retransmit cache
+
+    # ---- test / emulation modes (ref: PaxosConfig.java:435,453) -------
+    EMULATE_UNREPLICATED = False
+    LAZY_PROPAGATION = False
+
+    # ---- transport ------------------------------------------------------
+    CLIENT_PORT_OFFSET = 100             # ref: ReconfigurationConfig port offsets
+    HTTP_PORT_OFFSET = 300
+    CHARSET = "ISO-8859-1"
+
+
+Config.register(PC)

@@ -1,0 +1,3 @@
+from .sim import SimCluster, SafetyChecker
+
+__all__ = ["SimCluster", "SafetyChecker"]

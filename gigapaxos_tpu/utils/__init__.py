@@ -1,0 +1,4 @@
+from .config import Config
+from .profiler import DelayProfiler
+
+__all__ = ["Config", "DelayProfiler"]

@@ -1,0 +1,22 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU platform so
+multi-chip sharding paths are exercised without TPU hardware (the bench and
+driver use the real chip; tests never should)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clear_config():
+    from gigapaxos_tpu.utils.config import Config
+
+    yield
+    Config.clear()

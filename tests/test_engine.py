@@ -1,0 +1,230 @@
+"""Safety + liveness property tests for the batched consensus engine.
+
+Mirrors the reference's test strategy (SURVEY.md §4): in-process multi-node
+cluster with emulated crashes/delays, asserting the RSM invariant (identical
+app state at identical frontiers, ``TESTPaxosMain.assertRSMInvariant``),
+decision agreement, and ballot/frontier monotonicity under random message
+schedules — the highest-risk properties of the vectorized design.
+"""
+
+import numpy as np
+import pytest
+
+from gigapaxos_tpu.ops.ballot import NULL, ballot_coord, ballot_num, encode_ballot
+from gigapaxos_tpu.ops.engine import EngineConfig, STOP_BIT
+from gigapaxos_tpu.testing.sim import DELIVER, DROP, STALE, SimCluster
+
+
+def make_cluster(G=4, W=8, K=4, R=3):
+    cfg = EngineConfig(n_groups=G, window=W, req_lanes=K, n_replicas=R)
+    c = SimCluster(cfg)
+    c.create_all_groups()
+    return c
+
+
+def reqs_for(c, g, vids):
+    """Build a request injection dict targeted at group g's coordinator."""
+    cfg = c.cfg
+    arr = np.full((cfg.n_groups, cfg.req_lanes), NULL, np.int32)
+    arr[g, : len(vids)] = vids
+    return {c.coordinator_of(g): arr}
+
+
+def test_ballot_codec():
+    b = encode_ballot(5, 2)
+    assert ballot_num(b) == 5 and ballot_coord(b) == 2
+    assert encode_ballot(5, 2) > encode_ballot(4, 31)
+    assert encode_ballot(5, 3) > encode_ballot(5, 2)
+
+
+def test_single_commit():
+    c = make_cluster()
+    c.step_all(reqs=reqs_for(c, 0, [101]))
+    c.run(4)
+    fr = c.exec_frontiers()
+    assert (fr[:, 0] == 1).all(), fr
+    c.assert_rsm_invariant()
+    assert c.checker.chosen[(0, 0)] == 101
+
+
+def test_pipelined_commits_all_groups():
+    c = make_cluster(G=8)
+    vid = 1
+    sent = {g: [] for g in range(8)}
+    for _ in range(12):
+        inject = {}
+        staged = {}
+        for g in range(8):
+            rid = c.coordinator_of(g)
+            arr = inject.setdefault(
+                rid, np.full((c.cfg.n_groups, c.cfg.req_lanes), NULL, np.int32)
+            )
+            vids = list(range(vid, vid + c.cfg.req_lanes))
+            vid += c.cfg.req_lanes
+            arr[g, :] = vids
+            staged[g] = (rid, vids)
+        outs = c.step_all(reqs=inject)
+        # the engine refuses lanes when the slot window is full; the host
+        # batcher requeues those — here we just track what WAS admitted
+        for g, (rid, vids) in staged.items():
+            n = int(np.asarray(outs[rid].n_admitted)[g])
+            sent[g].extend(vids[:n])
+    c.run(6)
+    fr = c.exec_frontiers()
+    # every group fully committed and executed everywhere
+    assert (fr == fr[0]).all()
+    assert fr.min() > 0
+    c.assert_rsm_invariant()
+    # ordering: committed vids per group are exactly the admitted sequence
+    for g in range(8):
+        committed = [
+            c.checker.chosen[(g, s)] for s in range(int(fr[0, g]))
+        ]
+        assert committed == sent[g], (g, committed, sent[g])
+        assert len(committed) > 0
+
+
+def test_straggler_catches_up_via_decision_rings():
+    c = make_cluster(G=2)
+    # replica 2 hears nothing for a while; 0 and 1 keep committing
+    part = np.full((3, 3), DELIVER)
+    part[2, 0] = part[2, 1] = DROP
+    part[0, 2] = part[1, 2] = DROP
+    vid = 1
+    for _ in range(6):
+        arr = np.full((c.cfg.n_groups, c.cfg.req_lanes), NULL, np.int32)
+        arr[0, 0] = vid
+        arr[1, 0] = vid + 1
+        vid += 2
+        # groups 0,1 have coordinators 0,1 (round robin) — both live
+        c.step_all(reqs={c.coordinator_of(0): arr}, delivery=part)
+    fr = c.exec_frontiers()
+    assert fr[2].sum() == 0 or fr[2].sum() < fr[0].sum()
+    # heal the partition: straggler must catch up purely from decision rings
+    c.run(6)
+    fr = c.exec_frontiers()
+    assert (fr[2] == fr[0]).all(), fr
+    c.assert_rsm_invariant()
+
+
+def test_coordinator_failover():
+    c = make_cluster(G=1)
+    c.step_all(reqs=reqs_for(c, 0, [11]))
+    c.run(4)
+    assert (c.exec_frontiers()[:, 0] == 1).all()
+    dead = c.coordinator_of(0)
+    alive = [r for r in range(3) if r != dead]
+    # kill the coordinator (drop all its links both ways)
+    d = np.full((3, 3), DELIVER)
+    for r in range(3):
+        d[r, dead] = DROP
+        d[dead, r] = DROP
+    # failure detector fires on a live replica
+    want = np.zeros((1,), bool)
+    want[0] = True
+    c.step_all(want_coord={alive[0]: want}, delivery=d)
+    c.run(4, delivery=d)
+    # new coordinator commits new requests
+    arr = np.full((c.cfg.n_groups, c.cfg.req_lanes), NULL, np.int32)
+    arr[0, 0] = 77
+    c.step_all(reqs={alive[0]: arr}, delivery=d)
+    c.run(5, delivery=d)
+    fr = c.exec_frontiers()
+    assert fr[alive[0], 0] >= 2, fr
+    assert fr[alive[1], 0] >= 2, fr
+    assert c.checker.chosen[(0, 1)] == 77
+    c.assert_rsm_invariant(groups=[0])
+    # the old coordinator rejoins and catches up
+    c.run(6)
+    assert (c.exec_frontiers()[:, 0] == fr[alive[0], 0]).all()
+    c.assert_rsm_invariant(groups=[0])
+
+
+def test_dueling_coordinators_safe():
+    c = make_cluster(G=1, W=8, K=2)
+    rng = np.random.default_rng(0)
+    vid = 1
+    for t in range(40):
+        want = np.zeros((1,), bool)
+        want[0] = True
+        wc = {t % 3: want} if t % 4 == 0 else {}
+        arr = np.full((1, 2), NULL, np.int32)
+        arr[0, 0] = vid
+        vid += 1
+        rid = int(rng.integers(0, 3))
+        c.step_all(reqs={rid: arr}, want_coord=wc)
+    c.run(8)
+    c.assert_rsm_invariant()
+    # progress must have happened despite the churn
+    assert c.exec_frontiers()[0, 0] > 0
+
+
+def test_random_schedule_fuzz():
+    """The big one: random drops/stale-delivery/elections for many steps;
+    every step asserts agreement + monotonicity; then heal and converge."""
+    c = make_cluster(G=6, W=8, K=2)
+    rng = np.random.default_rng(42)
+    vid = 1
+    for t in range(120):
+        delivery = rng.choice(
+            [DELIVER, STALE, DROP], size=(3, 3), p=[0.6, 0.2, 0.2]
+        )
+        inject = {}
+        for g in range(6):
+            if rng.random() < 0.5:
+                rid = int(rng.integers(0, 3))
+                arr = inject.setdefault(
+                    rid, np.full((6, 2), NULL, np.int32)
+                )
+                arr[g, 0] = vid
+                vid += 1
+        wc = {}
+        if rng.random() < 0.1:
+            w = rng.random(6) < 0.3
+            wc[int(rng.integers(0, 3))] = w
+        c.step_all(reqs=inject, want_coord=wc, delivery=delivery)
+    # heal: full delivery, one replica nudged to lead any stuck group
+    for t in range(30):
+        wc = {}
+        if t % 10 == 0:
+            wc = {t % 3: np.ones(6, bool)}
+        c.step_all(want_coord=wc)
+    fr = c.exec_frontiers()
+    assert (fr == fr[0]).all(), fr
+    c.assert_rsm_invariant()
+    assert c.checker.total_committed() > 20
+
+
+def test_stop_request_halts_group():
+    c = make_cluster(G=1, K=4)
+    stop_vid = 5 | STOP_BIT
+    c.step_all(reqs=reqs_for(c, 0, [1, 2, stop_vid, 4]))
+    c.run(6)
+    fr = c.exec_frontiers()
+    # slots 0,1 committed; stop at slot 2 committed; lane 3's request 4 must
+    # NOT have been admitted after the stop
+    assert (fr[:, 0] == 3).all(), fr
+    assert c.checker.chosen[(0, 2)] == stop_vid
+    assert (0, 3) not in c.checker.chosen
+    # group is stopped: further requests are refused
+    c.step_all(reqs=reqs_for(c, 0, [99]))
+    c.run(4)
+    assert (c.exec_frontiers()[:, 0] == 3).all()
+    for r in range(3):
+        assert int(np.asarray(c.states[r].stopped)[0]) == 1
+
+
+def test_per_group_membership_subset():
+    """Groups with a 2-of-3 member subset: non-member must stay untouched."""
+    cfg = EngineConfig(n_groups=2, window=8, req_lanes=2, n_replicas=3)
+    c = SimCluster(cfg)
+    c.create_group(0, members=[0, 1])
+    c.create_group(1, members=[0, 1, 2])
+    arr = np.full((2, 2), NULL, np.int32)
+    arr[0, 0] = 10
+    c.step_all(reqs={c.coordinator_of(0): arr})
+    c.run(5)
+    fr = c.exec_frontiers()
+    assert fr[0, 0] == 1 and fr[1, 0] == 1
+    assert fr[2, 0] == 0  # non-member untouched
+    c.assert_rsm_invariant(groups=[1])

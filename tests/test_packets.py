@@ -1,0 +1,83 @@
+"""Packet serialization round-trips (mirrors ``RequestPacketTest.java``)."""
+
+from gigapaxos_tpu.packets import (
+    AcceptPacket,
+    Ballot,
+    FailureDetectionPacket,
+    PaxosPacket,
+    PaxosPacketType,
+    PreparePacket,
+    PrepareReplyPacket,
+    PValuePacket,
+    RequestPacket,
+    packet_from_json,
+)
+
+
+def test_request_roundtrip_json():
+    req = RequestPacket(
+        paxos_id="svc0", version=3, request_value="hello world", stop=True,
+        entry_replica=1, client_address=("127.0.0.1", 9999),
+    )
+    back = packet_from_json(req.to_json())
+    assert isinstance(back, RequestPacket)
+    assert back.paxos_id == "svc0" and back.version == 3
+    assert back.request_value == "hello world"
+    assert back.stop and back.entry_replica == 1
+    assert back.client_address == ("127.0.0.1", 9999)
+    assert back.request_id == req.request_id
+
+
+def test_request_roundtrip_bytes():
+    req = RequestPacket(paxos_id="x", request_value="v" * 100)
+    data = req.to_bytes()
+    back = PaxosPacket.from_bytes(data)
+    assert isinstance(back, RequestPacket)
+    assert back.request_value == req.request_value
+
+
+def test_batched_requests():
+    reqs = [RequestPacket(paxos_id="s", request_value=f"r{i}") for i in range(5)]
+    head = reqs[0].latch_to_batch(reqs[1:])
+    assert head.batch_size() == 5
+    back = packet_from_json(packet_from_json(head.to_json()).to_json())
+    assert back.batch_size() == 5
+    assert [r.request_value for r in back.flatten()] == [f"r{i}" for i in range(5)]
+
+
+def test_pvalue_and_accept():
+    acc = AcceptPacket(
+        paxos_id="g", slot=42, ballot_num=7, ballot_coord=2,
+        request_value="payload", sender=0,
+    )
+    back = PaxosPacket.from_bytes(acc.to_bytes())
+    assert isinstance(back, AcceptPacket)
+    assert back.PACKET_TYPE == PaxosPacketType.ACCEPT
+    assert back.slot == 42 and back.ballot == Ballot(7, 2)
+
+
+def test_prepare_reply_accepted_map():
+    pr = PrepareReplyPacket(
+        paxos_id="g", acceptor=1, ballot_num=3, ballot_coord=0,
+        accepted={5: PValuePacket(paxos_id="g", slot=5, ballot_num=2,
+                                  ballot_coord=1, request_value="v5")},
+    )
+    back = packet_from_json(pr.to_json())
+    assert isinstance(back, PrepareReplyPacket)
+    assert back.accepted[5].request_value == "v5"
+    assert back.accepted[5].slot == 5
+
+
+def test_prepare_and_fd():
+    p = PreparePacket(paxos_id="g", ballot_num=9, ballot_coord=1,
+                      first_undecided_slot=17)
+    assert packet_from_json(p.to_json()).first_undecided_slot == 17
+    fd = FailureDetectionPacket(sender="AR0", responder="AR1", send_time=1.25)
+    back = packet_from_json(fd.to_json())
+    assert back.sender == "AR0" and back.send_time == 1.25
+
+
+def test_ballot_ordering():
+    assert Ballot(2, 1) > Ballot(1, 9)
+    assert Ballot(2, 3) > Ballot(2, 1)
+    assert Ballot.parse("5:2") == Ballot(5, 2)

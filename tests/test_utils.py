@@ -1,0 +1,50 @@
+"""Unit tests for the config/flag system and profiler (mirrors the
+reference's utils self-tests, e.g. ``utils/UtilTest.java``)."""
+
+import enum
+
+from gigapaxos_tpu.utils.config import Config, parse_properties
+from gigapaxos_tpu.utils.profiler import DelayProfiler
+
+
+class Flags(enum.Enum):
+    ALPHA = 42
+    BETA = True
+    GAMMA = "hello"
+    DELTA = 1.5
+
+
+Config.register(Flags)
+
+
+def test_defaults():
+    assert Config.get(Flags.ALPHA) == 42
+    assert Config.get_bool(Flags.BETA) is True
+    assert Config.get_str(Flags.GAMMA) == "hello"
+    assert Config.get_float(Flags.DELTA) == 1.5
+
+
+def test_three_tiers(tmp_path):
+    p = tmp_path / "t.properties"
+    p.write_text("ALPHA=7\nBETA=false\n# comment\nactive.AR0=1.2.3.4:2000\n")
+    Config.load_file(str(p))
+    assert Config.get_int(Flags.ALPHA) == 7          # file beats default
+    assert Config.get_bool(Flags.BETA) is False
+    rest = Config.register_args(["ALPHA=9", "positional", "-x"])
+    assert rest == ("positional", "-x")
+    assert Config.get_int(Flags.ALPHA) == 9          # CLI beats file
+    assert Config.node_addresses("active") == {"AR0": ("1.2.3.4", 2000)}
+
+
+def test_parse_properties():
+    props = parse_properties("a=1\nb: two\n!ignored\n\nc = 3 ")
+    assert props == {"a": "1", "b": "two", "c": "3"}
+
+
+def test_profiler():
+    DelayProfiler.clear()
+    DelayProfiler.update_mov_avg("lat", 1.0)
+    DelayProfiler.update_count("reqs", 5)
+    assert DelayProfiler.get("lat") == 1.0
+    assert DelayProfiler.get("reqs") == 5
+    assert "lat" in DelayProfiler.get_stats()
