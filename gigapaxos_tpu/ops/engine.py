@@ -210,6 +210,11 @@ def step(
 
     inert = state.member_mask == 0
     maj = state.majority
+    # Am I a member of each group?  A replica holds rows for groups it does
+    # not belong to (the [G] arrays are capacity, not membership); it must
+    # neither mutate nor act on those rows (the reference simply has no
+    # PaxosInstanceStateMachine object for such groups).
+    i_member = ((state.member_mask >> my_id) & 1) == 1
 
     # ---- 1. promise update (handlePrepare / acceptAndUpdateBallot) ----
     in_prep = jnp.where(live, g.prep_bal, NULL)
@@ -425,12 +430,17 @@ def step(
         c_phase=phase, c_bal=c_bal, c_next_slot=c_next,
         c_prop_vid=c_prop_vid, c_prop_slot=c_prop_slot,
     )
+    # Non-member rows stay frozen (and report nothing).
+    m1 = i_member
+    m2 = i_member[:, None]
+    keep = lambda new, old: jnp.where(m1 if new.ndim == 1 else m2, new, old)
+    new_state = EngineState(*(keep(n, o) for n, o in zip(new_state, state)))
     outputs = StepOutputs(
-        n_committed=n_adv,
+        n_committed=jnp.where(m1, n_adv, 0),
         exec_base=state.exec_slot,
-        exec_vid=jnp.where(run > 0, d_vid_at, NULL),
-        n_admitted=n_admit,
-        maj_exec=maj_exec,
-        app_hash=h,
+        exec_vid=jnp.where(m2 & (run > 0), d_vid_at, NULL),
+        n_admitted=jnp.where(m1, n_admit, 0),
+        maj_exec=jnp.where(m1, maj_exec, 0),
+        app_hash=new_state.app_hash,
     )
     return new_state, outputs

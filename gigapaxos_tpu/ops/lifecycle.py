@@ -19,10 +19,11 @@ from .ballot import NULL, encode_ballot
 from .engine import ACTIVE, IDLE, EngineState
 
 
-def _popcount16(x: jnp.ndarray) -> jnp.ndarray:
-    """Popcount for small masks (MAX_GROUP_SIZE=16 => <= 16 bits)."""
+def _popcount32(x: jnp.ndarray) -> jnp.ndarray:
+    """Popcount over the full 32-bit replica-id space (ballot.py COORD_BITS=5
+    supports ids 0..31; arithmetic >> keeps bit 31 correct for int32)."""
     c = jnp.zeros_like(x)
-    for b in range(16):
+    for b in range(32):
         c = c + ((x >> b) & 1)
     return c
 
@@ -66,7 +67,7 @@ def create_groups(
     zeros = jnp.zeros((n,), jnp.int32)
     return state._replace(
         member_mask=state.member_mask.at[idx].set(member_mask),
-        majority=state.majority.at[idx].set(_popcount16(member_mask) // 2 + 1),
+        majority=state.majority.at[idx].set(_popcount32(member_mask) // 2 + 1),
         version=state.version.at[idx].set(version),
         stopped=state.stopped.at[idx].set(0),
         bal=state.bal.at[idx].set(bal0),

@@ -7,12 +7,14 @@ serve (a) the client/entry path, (b) the journal/recovery record format,
 (c) the host control plane (failure detection, sync, checkpoint transfer),
 and (d) loopback/debug interop.
 
-Binary layout: every packet serializes as msgpack-free hand-rolled
-struct: a 4-byte type int, then type-specific fixed fields, then
-length-prefixed variable fields — in the spirit of the reference's
-fixed-layout ``RequestPacket.toBytes`` (``RequestPacket.java:749-927``)
-without copying its exact layout.  JSON codec mirrors the reference's
-smart-JSON fallback.
+Binary layout: ``to_bytes`` frames each packet as a big-endian
+``(type:int32, body_len:int32)`` header followed by the UTF-8 JSON body —
+the general-purpose wire/debug form (the analog of the reference's
+smart-JSON fallback).  The performance-critical paths do not use this
+codec at all: inter-replica consensus traffic is packed int32 tensors
+(``ops/engine.py``) and the durability journal uses its own fixed binary
+record format (``storage/``), playing the role of the reference's
+fixed-layout ``RequestPacket.toBytes`` (``RequestPacket.java:749-927``).
 """
 
 from __future__ import annotations
@@ -141,12 +143,22 @@ class RequestPacket(PaxosPacket):
     def __post_init__(self):
         if self.request_id == 0:
             self.request_id = random.randrange(1, 2 ** 62)
+        # Nested entries may be subclasses (ProposalPacket/PValuePacket);
+        # their "pt" tag picks the right class back out of the registry.
         self.batched = [
-            RequestPacket.from_json(b) if isinstance(b, dict) else b
+            (packet_from_json(b) if "pt" in b else RequestPacket.from_json(b))
+            if isinstance(b, dict) else b
             for b in self.batched
         ]
         if isinstance(self.client_address, list):
             self.client_address = (self.client_address[0], self.client_address[1])
+
+    def to_json(self) -> Dict:
+        d = super().to_json()
+        # asdict() deep-converts nested packets but drops their type tags;
+        # re-emit each with its own to_json so round-trips preserve classes.
+        d["batched"] = [b.to_json() for b in self.batched]
+        return d
 
     # Request-ish API used by the manager/apps
     def get_service_name(self) -> str:

@@ -86,7 +86,7 @@ def spmd_step(cfg: EngineConfig, mesh: Mesh):
             P(REPLICA_AXIS, GROUP_AXIS),
         ),
         out_specs=(state_spec, out_spec),
-        check_rep=False,
+        check_vma=False,
     )
     def _sharded(states, req_vid, want_coord):
         # local shapes: leaves [1, G_loc, ...]

@@ -30,10 +30,11 @@ _STEP_JIT = None
 
 def _shared_step_jit():
     """One jit wrapper shared by all clusters so identical shapes reuse the
-    compiled executable across tests."""
+    compiled executable across tests.  ``my_id`` is traced (not static) so
+    all R replicas share one executable per cfg."""
     global _STEP_JIT
     if _STEP_JIT is None:
-        _STEP_JIT = jax.jit(step, static_argnames=("my_id", "cfg"))
+        _STEP_JIT = jax.jit(step, static_argnames=("cfg",))
     return _STEP_JIT
 
 
@@ -124,10 +125,18 @@ class SimCluster:
             )
 
     def coordinator_of(self, g: int) -> int:
-        """Current believed coordinator (replica 0's view of ballot coord)."""
-        from ..ops.ballot import ballot_coord
+        """Current believed coordinator: the max promised ballot's coord over
+        the group's *members* (a non-member's frozen row would go stale)."""
+        from ..ops.ballot import NULL as BNULL, ballot_coord
 
-        return int(ballot_coord(np.asarray(self.states[0].bal)[g]))
+        mask = int(np.asarray(self.states[0].member_mask)[g])
+        members = [r for r in range(self.cfg.n_replicas) if (mask >> r) & 1]
+        if not members:
+            raise ValueError(f"group {g} has no members")
+        bal = max(int(np.asarray(self.states[r].bal)[g]) for r in members)
+        if bal == BNULL:
+            return members[0]
+        return int(ballot_coord(bal))
 
     # ---- stepping --------------------------------------------------------
     def step_all(
@@ -173,7 +182,7 @@ class SimCluster:
             wc = no_want if wc is None else jnp.asarray(wc, bool)
             new_state, out = self._step_jit(
                 self.states[i], gathered, jnp.asarray(heard), rv, wc,
-                my_id=i, cfg=cfg,
+                jnp.int32(i), cfg=cfg,
             )
             self.states[i] = new_state
             outs.append(out)

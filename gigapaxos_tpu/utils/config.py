@@ -71,8 +71,9 @@ class Config:
             cls._registered[flag_enum.__name__] = flag_enum
             for member in flag_enum:
                 cls._defaults[f"{flag_enum.__name__}.{member.name}"] = member.value
-                # Bare name resolves too unless shadowed by a later enum.
-                cls._defaults.setdefault(member.name, member.value)
+                # Bare name resolves too; a later-registered enum shadows an
+                # earlier one (qualified "Enum.MEMBER" names never collide).
+                cls._defaults[member.name] = member.value
 
     @classmethod
     def load_file(cls, path: str) -> None:

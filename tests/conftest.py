@@ -1,15 +1,24 @@
 """Test configuration: force JAX onto a virtual 8-device CPU platform so
 multi-chip sharding paths are exercised without TPU hardware (the bench and
-driver use the real chip; tests never should)."""
+driver use the real chip; tests never should).
+
+Note: a site hook may register a TPU-proxy backend and override
+``jax_platforms`` via ``jax.config`` at interpreter startup, so setting the
+``JAX_PLATFORMS`` env var alone is NOT enough — we must also write the
+config back to "cpu" after importing jax and before any backend init."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -5,6 +5,9 @@ cluster with emulated crashes/delays, asserting the RSM invariant (identical
 app state at identical frontiers, ``TESTPaxosMain.assertRSMInvariant``),
 decision agreement, and ballot/frontier monotonicity under random message
 schedules — the highest-risk properties of the vectorized design.
+
+All clusters share ONE EngineConfig (G=8, W=8, K=4, R=3) so the whole suite
+reuses a single compiled step executable (``my_id`` is traced, not static).
 """
 
 import numpy as np
@@ -14,18 +17,24 @@ from gigapaxos_tpu.ops.ballot import NULL, ballot_coord, ballot_num, encode_ball
 from gigapaxos_tpu.ops.engine import EngineConfig, STOP_BIT
 from gigapaxos_tpu.testing.sim import DELIVER, DROP, STALE, SimCluster
 
+G, W, K, R = 8, 8, 4, 3
+CFG = EngineConfig(n_groups=G, window=W, req_lanes=K, n_replicas=R)
 
-def make_cluster(G=4, W=8, K=4, R=3):
-    cfg = EngineConfig(n_groups=G, window=W, req_lanes=K, n_replicas=R)
-    c = SimCluster(cfg)
-    c.create_all_groups()
+
+def make_cluster(create_all=True):
+    c = SimCluster(CFG)
+    if create_all:
+        c.create_all_groups()
     return c
+
+
+def no_reqs():
+    return np.full((G, K), NULL, np.int32)
 
 
 def reqs_for(c, g, vids):
     """Build a request injection dict targeted at group g's coordinator."""
-    cfg = c.cfg
-    arr = np.full((cfg.n_groups, cfg.req_lanes), NULL, np.int32)
+    arr = no_reqs()
     arr[g, : len(vids)] = vids
     return {c.coordinator_of(g): arr}
 
@@ -48,19 +57,17 @@ def test_single_commit():
 
 
 def test_pipelined_commits_all_groups():
-    c = make_cluster(G=8)
+    c = make_cluster()
     vid = 1
-    sent = {g: [] for g in range(8)}
+    sent = {g: [] for g in range(G)}
     for _ in range(12):
         inject = {}
         staged = {}
-        for g in range(8):
+        for g in range(G):
             rid = c.coordinator_of(g)
-            arr = inject.setdefault(
-                rid, np.full((c.cfg.n_groups, c.cfg.req_lanes), NULL, np.int32)
-            )
-            vids = list(range(vid, vid + c.cfg.req_lanes))
-            vid += c.cfg.req_lanes
+            arr = inject.setdefault(rid, no_reqs())
+            vids = list(range(vid, vid + K))
+            vid += K
             arr[g, :] = vids
             staged[g] = (rid, vids)
         outs = c.step_all(reqs=inject)
@@ -76,23 +83,21 @@ def test_pipelined_commits_all_groups():
     assert fr.min() > 0
     c.assert_rsm_invariant()
     # ordering: committed vids per group are exactly the admitted sequence
-    for g in range(8):
-        committed = [
-            c.checker.chosen[(g, s)] for s in range(int(fr[0, g]))
-        ]
+    for g in range(G):
+        committed = [c.checker.chosen[(g, s)] for s in range(int(fr[0, g]))]
         assert committed == sent[g], (g, committed, sent[g])
         assert len(committed) > 0
 
 
 def test_straggler_catches_up_via_decision_rings():
-    c = make_cluster(G=2)
+    c = make_cluster()
     # replica 2 hears nothing for a while; 0 and 1 keep committing
     part = np.full((3, 3), DELIVER)
     part[2, 0] = part[2, 1] = DROP
     part[0, 2] = part[1, 2] = DROP
     vid = 1
     for _ in range(6):
-        arr = np.full((c.cfg.n_groups, c.cfg.req_lanes), NULL, np.int32)
+        arr = no_reqs()
         arr[0, 0] = vid
         arr[1, 0] = vid + 1
         vid += 2
@@ -108,7 +113,7 @@ def test_straggler_catches_up_via_decision_rings():
 
 
 def test_coordinator_failover():
-    c = make_cluster(G=1)
+    c = make_cluster()
     c.step_all(reqs=reqs_for(c, 0, [11]))
     c.run(4)
     assert (c.exec_frontiers()[:, 0] == 1).all()
@@ -120,12 +125,12 @@ def test_coordinator_failover():
         d[r, dead] = DROP
         d[dead, r] = DROP
     # failure detector fires on a live replica
-    want = np.zeros((1,), bool)
+    want = np.zeros((G,), bool)
     want[0] = True
     c.step_all(want_coord={alive[0]: want}, delivery=d)
     c.run(4, delivery=d)
     # new coordinator commits new requests
-    arr = np.full((c.cfg.n_groups, c.cfg.req_lanes), NULL, np.int32)
+    arr = no_reqs()
     arr[0, 0] = 77
     c.step_all(reqs={alive[0]: arr}, delivery=d)
     c.run(5, delivery=d)
@@ -141,14 +146,14 @@ def test_coordinator_failover():
 
 
 def test_dueling_coordinators_safe():
-    c = make_cluster(G=1, W=8, K=2)
+    c = make_cluster()
     rng = np.random.default_rng(0)
     vid = 1
     for t in range(40):
-        want = np.zeros((1,), bool)
+        want = np.zeros((G,), bool)
         want[0] = True
         wc = {t % 3: want} if t % 4 == 0 else {}
-        arr = np.full((1, 2), NULL, np.int32)
+        arr = no_reqs()
         arr[0, 0] = vid
         vid += 1
         rid = int(rng.integers(0, 3))
@@ -162,7 +167,7 @@ def test_dueling_coordinators_safe():
 def test_random_schedule_fuzz():
     """The big one: random drops/stale-delivery/elections for many steps;
     every step asserts agreement + monotonicity; then heal and converge."""
-    c = make_cluster(G=6, W=8, K=2)
+    c = make_cluster()
     rng = np.random.default_rng(42)
     vid = 1
     for t in range(120):
@@ -170,24 +175,22 @@ def test_random_schedule_fuzz():
             [DELIVER, STALE, DROP], size=(3, 3), p=[0.6, 0.2, 0.2]
         )
         inject = {}
-        for g in range(6):
+        for g in range(G):
             if rng.random() < 0.5:
                 rid = int(rng.integers(0, 3))
-                arr = inject.setdefault(
-                    rid, np.full((6, 2), NULL, np.int32)
-                )
+                arr = inject.setdefault(rid, no_reqs())
                 arr[g, 0] = vid
                 vid += 1
         wc = {}
         if rng.random() < 0.1:
-            w = rng.random(6) < 0.3
+            w = rng.random(G) < 0.3
             wc[int(rng.integers(0, 3))] = w
         c.step_all(reqs=inject, want_coord=wc, delivery=delivery)
     # heal: full delivery, one replica nudged to lead any stuck group
     for t in range(30):
         wc = {}
         if t % 10 == 0:
-            wc = {t % 3: np.ones(6, bool)}
+            wc = {t % 3: np.ones(G, bool)}
         c.step_all(want_coord=wc)
     fr = c.exec_frontiers()
     assert (fr == fr[0]).all(), fr
@@ -196,7 +199,7 @@ def test_random_schedule_fuzz():
 
 
 def test_stop_request_halts_group():
-    c = make_cluster(G=1, K=4)
+    c = make_cluster()
     stop_vid = 5 | STOP_BIT
     c.step_all(reqs=reqs_for(c, 0, [1, 2, stop_vid, 4]))
     c.run(6)
@@ -216,11 +219,10 @@ def test_stop_request_halts_group():
 
 def test_per_group_membership_subset():
     """Groups with a 2-of-3 member subset: non-member must stay untouched."""
-    cfg = EngineConfig(n_groups=2, window=8, req_lanes=2, n_replicas=3)
-    c = SimCluster(cfg)
+    c = make_cluster(create_all=False)
     c.create_group(0, members=[0, 1])
     c.create_group(1, members=[0, 1, 2])
-    arr = np.full((2, 2), NULL, np.int32)
+    arr = no_reqs()
     arr[0, 0] = 10
     c.step_all(reqs={c.coordinator_of(0): arr})
     c.run(5)

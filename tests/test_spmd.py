@@ -6,7 +6,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from gigapaxos_tpu.ops.ballot import NULL
+from gigapaxos_tpu.ops.ballot import NULL, ballot_coord
 from gigapaxos_tpu.ops.engine import EngineConfig, init_state
 from gigapaxos_tpu.ops.lifecycle import create_groups, initial_coordinator
 from gigapaxos_tpu.parallel.mesh import make_mesh, pick_mesh_shape
@@ -38,7 +38,7 @@ def drive(step_fn, states, cfg, n_steps, vid0=1):
     total = 0
     for _ in range(n_steps):
         req = np.full((R, G, K), NULL, np.int32)
-        coord = np.asarray(states.bal)[0] & 31  # ballot coord of each group
+        coord = ballot_coord(np.asarray(states.bal)[0])  # coord of each group
         for g in range(G):
             req[int(coord[g]), g, 0] = vid
             vid += 1
