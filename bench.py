@@ -1,0 +1,112 @@
+"""Headline benchmark: committed Paxos decisions/second on one TPU chip.
+
+The reference's benchmark is an in-process capacity probe
+(``TESTPaxosClient.probeCapacity``, ``TESTPaxosClient.java:799-895``): N
+virtual nodes in one JVM, load raised until the response rate degrades.
+The analog here: all R=3 replica engines advanced on one chip (the
+single-chip vmap mode, the N-nodes-in-one-JVM counterpart), G groups
+committing in lock-step, with the client/request path generated on-device
+so the measurement isolates the consensus engine exactly like the
+reference's in-JVM probe isolates its JVM path.
+
+Metric: committed decisions/s = slots executed per second by one replica
+(each slot is one agreed client request), across all groups.  The north
+star (BASELINE.json) is >= 10M decisions/s over ~1M groups.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import sys
+import time
+
+NORTH_STAR = 10_000_000.0  # decisions/s, BASELINE.json
+
+
+def main() -> None:
+    import os
+
+    import jax
+
+    # A site hook may override jax_platforms via jax.config at startup; honor
+    # an explicit JAX_PLATFORMS env var over that (e.g. JAX_PLATFORMS=cpu for
+    # a local smoke run without the TPU tunnel).
+    env_platforms = os.environ.get("JAX_PLATFORMS")
+    if env_platforms:
+        jax.config.update("jax_platforms", env_platforms)
+    try:
+        devs = jax.devices()
+    except Exception:
+        jax.config.update("jax_platforms", "cpu")
+        devs = jax.devices()
+    platform = devs[0].platform
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gigapaxos_tpu.ops.ballot import NULL
+    from gigapaxos_tpu.ops.engine import EngineConfig, init_state, make_blob, step
+    from gigapaxos_tpu.ops.lifecycle import create_groups, initial_coordinator
+
+    # ~1M groups on TPU HBM; smaller on CPU fallback so the line still prints.
+    G = 1_048_576 if platform != "cpu" else 8_192
+    W, K, R = 8, 4, 3
+    cfg = EngineConfig(n_groups=G, window=W, req_lanes=K, n_replicas=R)
+
+    idx = np.arange(G)
+    masks = np.full(G, (1 << R) - 1)
+    coord0 = (idx % R).astype(np.int32)  # round-robin initial coordinators
+    states = [
+        create_groups(init_state(cfg), idx, masks, coord0, my_id=rid)
+        for rid in range(R)
+    ]
+    states = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+
+    # On-device synthetic client load: K requests per group per step, sent to
+    # the coordinator replica's request lanes (entry-replica batching analog).
+    rids = jnp.arange(R, dtype=jnp.int32)
+    is_coord = (jnp.asarray(coord0)[None, :] == rids[:, None])  # [R, G]
+    vids = jnp.arange(1, K + 1, dtype=jnp.int32)  # constant vids; hashed anyway
+    req = jnp.where(is_coord[:, :, None], vids[None, None, :], NULL)  # [R, G, K]
+    want = jnp.zeros((R, G), dtype=bool)
+    heard = jnp.ones((R,), bool)
+    my_ids = jnp.arange(R, dtype=jnp.int32)
+
+    def one(states):
+        blobs = jax.vmap(make_blob)(states)
+        f = lambda s, r, w, m: step(s, blobs, heard, r, w, m, cfg)
+        return jax.vmap(f, in_axes=(0, 0, 0, 0))(states, req, want, my_ids)
+
+    @jax.jit
+    def run_chunk(states):
+        def body(s, _):
+            s, out = one(s)
+            return s, out.n_committed[0].sum()  # replica-0 view: each slot once
+        states, committed = jax.lax.scan(body, states, None, length=CHUNK)
+        return states, committed.sum()
+
+    CHUNK = 10
+    # Warmup: compile + reach steady state (pipeline fill).
+    states, _ = run_chunk(states)
+    states, c = run_chunk(states)
+    jax.block_until_ready(c)
+
+    t0 = time.perf_counter()
+    total = 0
+    n_chunks = 5
+    for _ in range(n_chunks):
+        states, c = run_chunk(states)
+        total += int(jax.block_until_ready(c))
+    dt = time.perf_counter() - t0
+
+    rate = total / dt
+    print(json.dumps({
+        "metric": "committed_decisions_per_s",
+        "value": round(rate, 1),
+        "unit": f"decisions/s ({G} groups, 3 replicas, 1 chip, {platform})",
+        "vs_baseline": round(rate / NORTH_STAR, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,7 +17,9 @@ from gigapaxos_tpu.ops.ballot import NULL, ballot_coord, ballot_num, encode_ball
 from gigapaxos_tpu.ops.engine import EngineConfig, STOP_BIT
 from gigapaxos_tpu.testing.sim import DELIVER, DROP, STALE, SimCluster
 
-G, W, K, R = 8, 8, 4, 3
+# G != W on purpose: a wrong-axis broadcast in the engine must raise a shape
+# error here rather than silently masking the wrong axis.
+G, W, K, R = 6, 8, 4, 3
 CFG = EngineConfig(n_groups=G, window=W, req_lanes=K, n_replicas=R)
 
 
