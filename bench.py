@@ -17,6 +17,7 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 
 import json
+import os
 import sys
 import time
 
@@ -24,8 +25,6 @@ NORTH_STAR = 10_000_000.0  # decisions/s, BASELINE.json
 
 
 def main() -> None:
-    import os
-
     import jax
 
     # A site hook may override jax_platforms via jax.config at startup; honor
@@ -42,50 +41,38 @@ def main() -> None:
     platform = devs[0].platform
 
     import jax.numpy as jnp
-    import numpy as np
 
     from gigapaxos_tpu.ops.ballot import NULL
-    from gigapaxos_tpu.ops.engine import EngineConfig, init_state, make_blob, step
-    from gigapaxos_tpu.ops.lifecycle import create_groups, initial_coordinator
+    from gigapaxos_tpu.ops.engine import EngineConfig
+    from gigapaxos_tpu.parallel.spmd import build_replica_states, single_chip_step
 
     # ~1M groups on TPU HBM; smaller on CPU fallback so the line still prints.
     G = 1_048_576 if platform != "cpu" else 8_192
     W, K, R = 8, 4, 3
     cfg = EngineConfig(n_groups=G, window=W, req_lanes=K, n_replicas=R)
-
-    idx = np.arange(G)
-    masks = np.full(G, (1 << R) - 1)
-    coord0 = (idx % R).astype(np.int32)  # round-robin initial coordinators
-    states = [
-        create_groups(init_state(cfg), idx, masks, coord0, my_id=rid)
-        for rid in range(R)
-    ]
-    states = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+    states = build_replica_states(cfg)
 
     # On-device synthetic client load: K requests per group per step, sent to
-    # the coordinator replica's request lanes (entry-replica batching analog).
+    # the coordinator replica's request lanes (entry-replica batching analog;
+    # coordinators are round-robin g % R, matching build_replica_states).
     rids = jnp.arange(R, dtype=jnp.int32)
-    is_coord = (jnp.asarray(coord0)[None, :] == rids[:, None])  # [R, G]
+    groups = jnp.arange(G, dtype=jnp.int32)
+    is_coord = (groups[None, :] % R) == rids[:, None]               # [R, G]
     vids = jnp.arange(1, K + 1, dtype=jnp.int32)  # constant vids; hashed anyway
     req = jnp.where(is_coord[:, :, None], vids[None, None, :], NULL)  # [R, G, K]
     want = jnp.zeros((R, G), dtype=bool)
-    heard = jnp.ones((R,), bool)
-    my_ids = jnp.arange(R, dtype=jnp.int32)
+    step_fn = single_chip_step(cfg)
 
-    def one(states):
-        blobs = jax.vmap(make_blob)(states)
-        f = lambda s, r, w, m: step(s, blobs, heard, r, w, m, cfg)
-        return jax.vmap(f, in_axes=(0, 0, 0, 0))(states, req, want, my_ids)
+    CHUNK = 10
 
     @jax.jit
     def run_chunk(states):
         def body(s, _):
-            s, out = one(s)
+            s, out = step_fn(s, req, want)
             return s, out.n_committed[0].sum()  # replica-0 view: each slot once
         states, committed = jax.lax.scan(body, states, None, length=CHUNK)
         return states, committed.sum()
 
-    CHUNK = 10
     # Warmup: compile + reach steady state (pipeline fill).
     states, _ = run_chunk(states)
     states, c = run_chunk(states)

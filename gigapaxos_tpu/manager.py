@@ -1,0 +1,609 @@
+"""PaxosManager — per-node host orchestration of the batched engine.
+
+API-parity target: ``PaxosManager`` (``PaxosManager.java:120`` —
+createPaxosInstance / propose / proposeStop / kill, packet dispatch,
+outstanding-request callbacks, response cache, recovery), re-architected
+around the vectorized engine:
+
+* All groups' consensus state lives on device ([G]/[G, W] arrays); the
+  manager owns the *host* side: name → group-row allocation, the request
+  payload arena, app execution, callbacks, durability, and the per-tick
+  drive loop.
+* Inter-replica consensus traffic is the engine blob (tensor exchange);
+  the manager's host channel carries only what tensors can't: request
+  payloads (vid → bytes), mirroring the reference's DIGEST_REQUESTS mode
+  (``PaxosConfig.java:780``) where accepts carry digests and request
+  bodies travel once.
+* A replica that is not a group's coordinator forwards proposals to the
+  believed coordinator (the unicast-PROPOSAL path,
+  ``PaxosInstanceStateMachine.java:837-851``) via the host channel.
+
+The tick cycle (one call to :meth:`tick`):
+  1. drain per-group request queues into the [G, K] admission lanes;
+  2. run the jitted engine step;
+  3. journal the accept delta (log-before-send,
+     ``AbstractPaxosLogger.logAndMessage`` rule) and new payloads;
+  4. execute newly decided slots in order through the app (payload-gated:
+     a slot whose payload hasn't arrived yet parks the group's cursor —
+     the retry-forever analog of ``PaxosInstanceStateMachine.execute``);
+  5. fire entry-replica callbacks / response cache;
+  6. return the fresh blob + host-channel payload delta for publication.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .interfaces.app import Replicable
+from .ops.ballot import NULL, ballot_coord
+from .ops.engine import (
+    STOP_BIT,
+    Blob,
+    EngineConfig,
+    EngineState,
+    init_state,
+    make_blob,
+    step,
+)
+from .ops.lifecycle import create_groups, kill_groups
+from .storage.logger import PaxosLogger
+from .utils.profiler import DelayProfiler
+
+_step_jit = jax.jit(step, static_argnames=("cfg",))
+
+# vid layout: [node_id : 5][counter : 24] under STOP_BIT (bit 30) — the
+# counter wraps per node at ~16M in-flight request payloads, far above the
+# outstanding cap; node ids follow ballot.COORD_BITS (ids 0..31).
+VID_NODE_SHIFT = 24
+VID_COUNTER_MASK = (1 << VID_NODE_SHIFT) - 1
+
+
+class Outstanding:
+    """Entry-replica callback table with TTL GC (GCConcurrentHashMap analog,
+    ``PaxosManager.java:192-207``)."""
+
+    def __init__(self, timeout_s: float = 8.0):
+        self.timeout_s = timeout_s
+        self._map: Dict[int, Tuple[float, Callable]] = {}
+
+    def put(self, request_id: int, cb: Callable) -> None:
+        self._map[request_id] = (time.time(), cb)
+
+    def pop(self, request_id: int) -> Optional[Callable]:
+        ent = self._map.pop(request_id, None)
+        return ent[1] if ent else None
+
+    def gc(self) -> int:
+        cut = time.time() - self.timeout_s
+        dead = [k for k, (t, _) in self._map.items() if t < cut]
+        for k in dead:
+            del self._map[k]
+        return len(dead)
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+
+class PaxosManager:
+    def __init__(
+        self,
+        my_id: int,
+        app: Replicable,
+        cfg: EngineConfig,
+        log_dir: Optional[str] = None,
+        sync_journal: bool = False,
+        checkpoint_every: int = 400,   # CHECKPOINT_INTERVAL slots analog
+    ):
+        self.my_id = int(my_id)
+        self.app = app
+        self.cfg = cfg
+        G, W, K = cfg.n_groups, cfg.window, cfg.req_lanes
+
+        self.logger: Optional[PaxosLogger] = (
+            PaxosLogger(my_id, log_dir, sync=sync_journal) if log_dir else None
+        )
+        self.checkpoint_every = checkpoint_every
+
+        # host-side tables
+        self.names: Dict[str, int] = {}        # service name -> group row
+        self.row_name: Dict[int, str] = {}     # occupancy: row -> name
+        self.arena: Dict[int, str] = {}        # vid -> request payload (json str)
+        self.vid_meta: Dict[int, Tuple[int, int]] = {}  # vid -> (entry_replica, request_id)
+        self.outstanding = Outstanding()
+        # keyed (entry_replica, request_id): request ids are only unique
+        # per entry node (each node numbers its own client requests)
+        self.response_cache: Dict[Tuple[int, int], Tuple[float, Optional[str]]] = {}
+        self._next_counter = 1
+        self.queues: Dict[int, List[int]] = {}  # group row -> pending vids
+        self.forward_out: List[Tuple[int, str, Dict]] = []  # (dst, kind, body)
+        self.app_exec_slot = np.zeros(G, np.int64)  # host app cursor per group
+        self.pending_exec: Dict[int, Dict[int, int]] = {}  # g -> slot -> vid
+        # executed payloads retained for straggler pulls until every live
+        # member's frontier passes the slot (sync/catch-up analog; a peer
+        # down past a checkpoint catches up via checkpoint transfer instead)
+        self.retained: Dict[int, Tuple[int, int]] = {}  # vid -> (row, slot)
+        self._min_exec = np.zeros(G, np.int64)
+        self._zero_cursors = np.zeros(G, np.int64)
+        self.peer_app_exec: Dict[int, np.ndarray] = {}  # rid -> [G] cursors
+        self._tick_no = 0
+        self.total_executed = 0
+        self._slots_since_ckpt = 0
+
+        self.state: EngineState = init_state(cfg)
+        self._recover()
+
+    # ------------------------------------------------------------------
+    # recovery (initiateRecovery analog, PaxosManager.java:1832-2035)
+    # ------------------------------------------------------------------
+    def _recover(self) -> None:
+        if self.logger is None:
+            return
+        seed = {k: np.asarray(v).copy() for k, v in self.state._asdict().items()}
+        rec = self.logger.recover(self.cfg.window, seed_arrays=seed)
+        if rec.arrays is None:
+            return
+        self.state = EngineState(
+            **{k: jnp.asarray(v) for k, v in rec.arrays.items()}
+        )
+        meta = rec.meta
+        for k, v in (meta.get("arena") or {}).items():
+            self.arena.setdefault(int(k), v)
+        for k, v in (meta.get("vid_meta") or {}).items():
+            self.vid_meta.setdefault(int(k), (v[0], v[1]))
+        self.arena.update(rec.payloads)  # journal blocks are newer
+        self.names = {str(k): int(v) for k, v in meta.get("names", {}).items()}
+        self.row_name = {v: k for k, v in self.names.items()}
+        self._next_counter = int(meta.get("next_counter", 1))
+        for vid in rec.payloads:
+            base = vid & ~STOP_BIT
+            if (base >> VID_NODE_SHIFT) == self.my_id:
+                self._next_counter = max(
+                    self._next_counter, (base & VID_COUNTER_MASK) + 1
+                )
+        ae = meta.get("app_exec_slot")
+        if ae is not None:
+            self.app_exec_slot = np.asarray(ae, np.int64)
+        else:
+            self.app_exec_slot = (
+                np.asarray(self.state.exec_slot).astype(np.int64).copy()
+            )
+        for g_str, pend in (meta.get("pending_exec") or {}).items():
+            self.pending_exec[int(g_str)] = {
+                int(s_): int(v) for s_, v in pend.items()
+            }
+        for name, state_str in (meta.get("app_states") or {}).items():
+            if name in self.names:
+                self.app.restore(name, state_str)
+        # decisions after the checkpoint replay through the engine (its
+        # exec frontier resumes from the snapshot), and the host cursor
+        # re-executes them once payloads re-enter via the journal arena.
+
+    # ------------------------------------------------------------------
+    # lifecycle (createPaxosInstance / kill, PaxosManager.java:611,2142)
+    # ------------------------------------------------------------------
+    def default_row_for(self, name: str) -> int:
+        """Deterministic row proposal: stable hash + linear probe over THIS
+        node's occupancy.  Only valid on the node initiating the create —
+        the chosen row must then be propagated in the create request so
+        every member maps the name to the SAME row (rows are the
+        cross-replica alignment key of the batched arrays; the reference
+        needs no such step because it keys everything by paxosID string)."""
+        import zlib
+
+        G = self.cfg.n_groups
+        row = zlib.crc32(name.encode("utf-8")) % G
+        for _ in range(G):
+            if row not in self.row_name:
+                return row
+            row = (row + 1) % G
+        raise RuntimeError("group capacity exhausted")
+
+    def create_paxos_instance(
+        self,
+        name: str,
+        members: List[int],
+        initial_state: Optional[str] = None,
+        version: int = 0,
+        row: Optional[int] = None,
+    ) -> bool:
+        if name in self.names:
+            return False
+        row = self.default_row_for(name) if row is None else int(row)
+        if row in self.row_name:
+            raise RuntimeError(
+                f"row {row} already hosts {self.row_name[row]!r} (create for "
+                f"{name!r} must carry the creator's row)"
+            )
+        self.names[name] = row
+        self.row_name[row] = name
+        mask = 0
+        for m in members:
+            mask |= 1 << m
+        coord0 = members[row % len(members)]
+        self.state = create_groups(
+            self.state, np.array([row]), np.array([mask]),
+            np.array([coord0]), my_id=self.my_id, version=version,
+        )
+        self.app_exec_slot[row] = 0
+        self.queues.pop(row, None)
+        self.pending_exec.pop(row, None)
+        if self.logger:
+            self.logger.log_create(
+                np.array([row]), np.array([mask]),
+                np.array([version]), np.array([coord0]),
+            )
+        if self.my_id in members:
+            self.app.restore(name, initial_state)
+        return True
+
+    def kill(self, name: str) -> bool:
+        row = self.names.pop(name, None)
+        if row is None:
+            return False
+        del self.row_name[row]
+        self.state = kill_groups(self.state, np.array([row]))
+        if self.logger:
+            self.logger.log_kill(np.array([row]))
+        self.queues.pop(row, None)
+        self.pending_exec.pop(row, None)
+        return True
+
+    def get_replica_group(self, name: str) -> Optional[List[int]]:
+        row = self.names.get(name)
+        if row is None:
+            return None
+        mask = int(np.asarray(self.state.member_mask)[row])
+        return [r for r in range(32) if (mask >> r) & 1]
+
+    # ------------------------------------------------------------------
+    # propose (PaxosManager.propose/proposeStop, :1195-1390)
+    # ------------------------------------------------------------------
+    def propose(
+        self,
+        name: str,
+        request_value: str,
+        callback: Optional[Callable] = None,
+        stop: bool = False,
+        request_id: Optional[int] = None,
+        entry_replica: Optional[int] = None,
+    ) -> Optional[int]:
+        """Enqueue a request for consensus; returns the assigned vid (or
+        None if the name is unknown here)."""
+        row = self.names.get(name)
+        if row is None:
+            return None
+        entry = self.my_id if entry_replica is None else entry_replica
+        request_id = (
+            request_id if request_id is not None else self._next_counter
+        )
+        # exactly-once: a retransmitted request id is answered from the
+        # response cache, not re-executed (PaxosManager.java:318-346)
+        if (entry, request_id) in self.response_cache:
+            if callback:
+                callback(request_id, self.response_cache[(entry, request_id)][1])
+            return None
+        # vids are GLOBALLY unique (node id in the high bits): they key the
+        # cross-replica payload arena, so two nodes must never mint the
+        # same vid for different requests.
+        if self._next_counter > VID_COUNTER_MASK:
+            raise RuntimeError("vid counter space exhausted")
+        vid = (self.my_id << VID_NODE_SHIFT) | self._next_counter
+        self._next_counter += 1
+        if stop:
+            vid |= STOP_BIT
+        self.arena[vid] = request_value
+        self.vid_meta[vid] = (entry, request_id)
+        if callback is not None:
+            self.outstanding.put(request_id, callback)
+        self.queues.setdefault(row, []).append(vid)
+        return vid
+
+    def propose_stop(self, name: str, request_value: str = "", **kw) -> Optional[int]:
+        return self.propose(name, request_value, stop=True, **kw)
+
+    # ------------------------------------------------------------------
+    # host channel ingress (payload replication + forwarded proposals)
+    # ------------------------------------------------------------------
+    def on_host_message(self, kind: str, body: Dict) -> None:
+        if kind == "payloads":
+            for k, v in body["arena"].items():
+                self.arena.setdefault(int(k), v)
+            for k, meta in body.get("meta", {}).items():
+                self.vid_meta.setdefault(int(k), (meta[0], meta[1]))
+            ae = body.get("app_exec")
+            if ae is not None:
+                rid, cursors = ae
+                cur = np.asarray(cursors, np.int64)
+                prev = self.peer_app_exec.get(rid)
+                self.peer_app_exec[rid] = (
+                    cur if prev is None else np.maximum(prev, cur)
+                )
+        elif kind == "forward":  # a peer forwards a proposal to me
+            self.propose(
+                body["name"], body["value"],
+                stop=body.get("stop", False),
+                request_id=body.get("request_id"),
+                entry_replica=body.get("entry", None),
+            )
+        elif kind == "need_payloads":  # straggler pull (sync analog)
+            have = {v: self.arena[v] for v in body["vids"] if v in self.arena}
+            if have:
+                meta = {
+                    v: list(self.vid_meta[v])
+                    for v in have if v in self.vid_meta
+                }
+                self.forward_out.append(
+                    (body["from"], "payloads", {"arena": have, "meta": meta})
+                )
+
+    # ------------------------------------------------------------------
+    # the tick
+    # ------------------------------------------------------------------
+    def coordinator_of_row(self, row: int) -> int:
+        return int(ballot_coord(int(np.asarray(self.state.bal)[row])))
+
+    def build_requests(self) -> np.ndarray:
+        """Drain queues into [G, K] lanes; forward non-coordinated groups'
+        requests to their believed coordinator."""
+        G, K = self.cfg.n_groups, self.cfg.req_lanes
+        req = np.full((G, K), NULL, np.int32)
+        bal = np.asarray(self.state.bal)
+        for row, vids in list(self.queues.items()):
+            if not vids:
+                continue
+            coord = int(ballot_coord(int(bal[row])))
+            if coord != self.my_id:
+                name = self.row_name.get(row)
+                if name is None:
+                    vids.clear()
+                    continue
+                for vid in vids:
+                    entry, rid = self.vid_meta.get(vid, (self.my_id, vid))
+                    self.forward_out.append((coord, "forward", {
+                        "name": name,
+                        "value": self.arena.get(vid, ""),
+                        "stop": bool(vid & STOP_BIT),
+                        "request_id": rid,
+                        "entry": entry,
+                    }))
+                    # the coordinator re-mints its own vid; our local copy
+                    # would only go stale (the callback stays in
+                    # self.outstanding keyed by request_id)
+                    self.arena.pop(vid, None)
+                    self.vid_meta.pop(vid, None)
+                vids.clear()
+                continue
+            take = vids[:K]
+            req[row, : len(take)] = take
+        return req
+
+    def tick(
+        self,
+        gathered: Blob,
+        heard: np.ndarray,
+        want_coord: Optional[np.ndarray] = None,
+    ) -> Tuple[Blob, Dict]:
+        """One full cycle; returns (my fresh blob, host-channel delta)."""
+        cfg = self.cfg
+        G, W, K = cfg.n_groups, cfg.window, cfg.req_lanes
+        req = self.build_requests()
+        wc = (
+            jnp.zeros((G,), bool) if want_coord is None
+            else jnp.asarray(want_coord, bool)
+        )
+        t0 = time.perf_counter()
+        new_state, out = _step_jit(
+            self.state, gathered, jnp.asarray(heard),
+            jnp.asarray(req), wc, jnp.int32(self.my_id), cfg=cfg,
+        )
+        self.state = new_state
+        DelayProfiler.update_delay("engine_step", time.perf_counter() - t0)
+
+        out_np = jax.tree.map(np.asarray, out)
+        self._tick_no += 1
+        # re-propose preempted requests at a fresh slot (PREEMPTED analog)
+        pre_g, pre_l = np.nonzero(out_np.preempted_vid != NULL)
+        for g_, l_ in zip(pre_g, pre_l):
+            vid = int(out_np.preempted_vid[g_, l_])
+            if vid in self.arena and vid not in self.retained:
+                self.queues.setdefault(int(g_), []).append(vid)
+        # payload-retention watermark: min APP-execution cursor over all
+        # group members (device frontiers can run ahead of payload-gated
+        # app execution — GC'ing on them would strand a parked peer).
+        # Peer cursors arrive by host-channel gossip; unheard-from peers
+        # hold the watermark down until they gossip (a long-dead member
+        # is eventually bypassed via checkpoint transfer, not GC).
+        mask = np.asarray(self.state.member_mask)
+        R = self.cfg.n_replicas
+        rids = np.arange(R)
+        in_group = ((mask[None, :] >> rids[:, None]) & 1) == 1
+        cursors = np.stack([
+            self.peer_app_exec.get(r, self._zero_cursors)
+            if r != self.my_id else self.app_exec_slot
+            for r in range(R)
+        ])
+        cur_masked = np.where(in_group, cursors, np.iinfo(np.int64).max)
+        self._min_exec = np.where(
+            in_group.any(axis=0), cur_masked.min(axis=0), self._min_exec
+        )
+        # requeue what wasn't admitted
+        n_adm = out_np.n_admitted
+        payload_delta: Dict[int, str] = {}
+        meta_delta: Dict[int, Tuple[int, int]] = {}
+        for row, vids in list(self.queues.items()):
+            if not vids:
+                continue
+            n = int(n_adm[row])
+            admitted, rest = vids[:n], vids[n:]
+            self.queues[row] = rest
+            for vid in admitted:
+                payload_delta[vid] = self.arena.get(vid, "")
+                if vid in self.vid_meta:
+                    meta_delta[vid] = self.vid_meta[vid]
+
+        # log-before-send: persist the accept delta before the blob leaves
+        if self.logger is not None:
+            gs, lanes = np.nonzero(out_np.acc_new)
+            if len(gs):
+                acc_slot = np.asarray(self.state.acc_slot)
+                acc_bal = np.asarray(self.state.acc_bal)
+                acc_vid = np.asarray(self.state.acc_vid)
+                self.logger.log_accepts(
+                    gs.astype(np.int32),
+                    acc_slot[gs, lanes],
+                    acc_bal[gs, lanes],
+                    acc_vid[gs, lanes],
+                )
+            if payload_delta:
+                self.logger.log_payloads(payload_delta)
+
+        self._execute(out_np)
+        self.outstanding.gc()
+        self._maybe_checkpoint(out_np)
+
+        host_delta = {
+            "arena": payload_delta,
+            "meta": {k: list(v) for k, v in meta_delta.items()},
+            "app_exec": (self.my_id, self.app_exec_slot.tolist()),
+        }
+        return make_blob(self.state), host_delta
+
+    # ------------------------------------------------------------------
+    # execution (EEC analog, PaxosInstanceStateMachine.java:1511-1734)
+    # ------------------------------------------------------------------
+    def _execute(self, out_np) -> None:
+        committed = np.nonzero(out_np.n_committed)[0]
+        if self.logger is not None and len(committed):
+            rows, slots, vids = [], [], []
+            for g in committed:
+                base = int(out_np.exec_base[g])
+                for o in range(int(out_np.n_committed[g])):
+                    rows.append(g)
+                    slots.append(base + o)
+                    vids.append(int(out_np.exec_vid[g, o]))
+            self.logger.log_decisions(
+                np.array(rows, np.int32), np.array(slots, np.int32),
+                np.array(vids, np.int32),
+            )
+        for g in committed:
+            base = int(out_np.exec_base[g])
+            pend = self.pending_exec.setdefault(int(g), {})
+            for o in range(int(out_np.n_committed[g])):
+                pend[base + o] = int(out_np.exec_vid[g, o])
+        # drain in order, payload-gated
+        missing: List[int] = []
+        for g in list(self.pending_exec.keys()):
+            pend = self.pending_exec[g]
+            name = self.row_name.get(g)
+            cursor = int(self.app_exec_slot[g])
+            while cursor in pend:
+                vid = pend[cursor]
+                if not self._execute_one(name, g, cursor, vid):
+                    missing.append(vid)
+                    break  # payload not here yet; pull + retry next tick
+                del pend[cursor]
+                cursor += 1
+            self.app_exec_slot[g] = cursor
+            if not pend:
+                del self.pending_exec[g]
+        if missing:
+            self.forward_out.append(
+                (-1, "need_payloads", {"vids": missing, "from": self.my_id})
+            )
+        # retention GC: drop payloads every live member has executed past
+        if self._tick_no % 32 == 0 and self.retained:
+            for vid, (g, slot) in list(self.retained.items()):
+                if slot < self._min_exec[g]:
+                    del self.retained[vid]
+                    self.arena.pop(vid, None)
+                    self.vid_meta.pop(vid, None)
+
+    def _execute_one(self, name: Optional[str], g: int, slot: int, vid: int) -> bool:
+        from .packets.paxos_packets import RequestPacket
+
+        if vid == 0:  # NOOP hole-filler: nothing to execute
+            return True
+        payload = self.arena.get(vid)
+        if payload is None:
+            return False
+        entry, request_id = self.vid_meta.get(vid, (-1, vid))
+        req = RequestPacket(
+            paxos_id=name or "", request_id=request_id,
+            request_value=payload, stop=bool(vid & STOP_BIT),
+        )
+        # retry-forever semantics (execute(), :1647-1734): a deterministic
+        # app either executes or the whole node is wedged; we retry a few
+        # times then raise, since silently skipping breaks the RSM.
+        for _ in range(3):
+            try:
+                if self.app.execute(req, do_not_reply_to_client=(entry != self.my_id)):
+                    break
+            except Exception:
+                pass
+            time.sleep(0.001)
+        else:
+            raise RuntimeError(f"app refused to execute {name}:{slot}")
+        self.total_executed += 1
+        self._slots_since_ckpt += 1
+        response = getattr(req, "response_value", None)
+        if entry == self.my_id:
+            self.response_cache[(entry, request_id)] = (time.time(), response)
+            cb = self.outstanding.pop(request_id)
+            if cb is not None:
+                cb(request_id, response)
+        self.retained[vid] = (g, slot)  # keep for straggler pulls
+        return True
+
+    # ------------------------------------------------------------------
+    # checkpointing (consistentCheckpoint analog, :1553-1615)
+    # ------------------------------------------------------------------
+    def _maybe_checkpoint(self, out_np) -> None:
+        if self.logger is None or self._slots_since_ckpt < self.checkpoint_every:
+            return
+        self.checkpoint_now()
+
+    def checkpoint_now(self) -> None:
+        if self.logger is None:
+            return
+        arrays = {k: np.asarray(v) for k, v in self.state._asdict().items()}
+        app_states = {
+            name: self.app.checkpoint(name) for name in self.names
+        }
+        # the live arena is exactly the payload set still needed by some
+        # replica (pending execution locally or retained for stragglers);
+        # pre-checkpoint PAYLOADS journal blocks are unreachable after this
+        # snapshot's GC, so they must travel in the snapshot itself
+        # app_states correspond to the APP cursor (app_exec_slot), which
+        # can trail the device frontier when payloads are in flight; the
+        # in-between (slot -> vid) map rides along so recovery resumes
+        # execution exactly where the app state string left off.
+        self.logger.checkpoint(arrays, app_states, {
+            "names": self.names,
+            "next_counter": self._next_counter,
+            "arena": self.arena,
+            "vid_meta": {k: list(v) for k, v in self.vid_meta.items()},
+            "app_exec_slot": self.app_exec_slot.tolist(),
+            "pending_exec": {
+                str(g): {str(s_): v for s_, v in pend.items()}
+                for g, pend in self.pending_exec.items()
+            },
+        })
+        self._slots_since_ckpt = 0
+        # response-cache GC piggybacks on checkpoint cadence
+        cut = time.time() - 60.0
+        for key in [k for k, (t, _) in self.response_cache.items() if t < cut]:
+            del self.response_cache[key]
+
+    def blob(self) -> Blob:
+        """Current publishable snapshot (what peers gather)."""
+        return make_blob(self.state)
+
+    def close(self) -> None:
+        if self.logger:
+            self.logger.close()

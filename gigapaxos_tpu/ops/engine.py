@@ -133,6 +133,11 @@ class StepOutputs(NamedTuple):
     n_admitted: jnp.ndarray    # [G] client reqs consumed from req_vid lanes
     maj_exec: jnp.ndarray      # [G] majority-rank execute frontier (GC mark)
     app_hash: jnp.ndarray      # [G] post-step app hash (RSM invariant probe)
+    acc_new: jnp.ndarray       # [G, W] lanes newly accepted this step — the
+    #   journal's log-before-send delta (AbstractPaxosLogger.logAndMessage
+    #   rule: these rows must be durable before the blob is published)
+    preempted_vid: jnp.ndarray  # [G, W] my proposals that lost their slot to
+    #   another value (host re-proposes them; NULL elsewhere)
 
 
 def _i32(x):
@@ -238,6 +243,12 @@ def step(
     acc_bal = jnp.where(do_acc, max_prop[:, None], state.acc_bal)
     acc_vid = jnp.where(do_acc, p_vid, state.acc_vid)
     acc_slot = jnp.where(do_acc, p_slot, state.acc_slot)
+    # True journal delta: an unchanged in-flight proposal re-fires do_acc
+    # every step until it decides — only a changed lane needs durability.
+    acc_changed = do_acc & (
+        (acc_bal != state.acc_bal) | (acc_vid != state.acc_vid)
+        | (acc_slot != state.acc_slot)
+    )
 
     # ---- 3. learn (the BatchedAcceptReply->DECISION collapse) ----
     ga_slot = jnp.where(live3, g.acc_slot, NULL)          # [R, G, W]
@@ -379,9 +390,18 @@ def step(
 
     # Retire proposals once their decision is learned (waitfor retirement,
     # PaxosCoordinatorState myProposals) or they fell below the frontier.
+    # A retired lane whose decided value differs from my proposal was
+    # PREEMPTED (another ballot chose a different value there) — surface
+    # those vids so the host can re-propose them at a fresh slot (the
+    # reference's PREEMPTED packet -> re-propose path, PValuePacket
+    # PREEMPTED / PaxosInstanceStateMachine.java:955-965).
     is_active = phase == ACTIVE
     dec_at_prop = dec_slot == c_prop_slot                 # lane-aligned
     retire = (c_prop_slot != NULL) & (dec_at_prop | (c_prop_slot < exec2))
+    preempted_vid = jnp.where(
+        retire & (dec_vid != c_prop_vid) & (c_prop_vid > 0),  # >0: no NOOPs
+        c_prop_vid, NULL,
+    )
     c_prop_vid = jnp.where(retire, NULL, c_prop_vid)
     c_prop_slot = jnp.where(retire, NULL, c_prop_slot)
 
@@ -401,14 +421,20 @@ def step(
 
     # Admit new client requests: consecutive slots from c_next, bounded by
     # the majority window (don't outrun a majority's rings) and free lanes.
+    # c_next must never lag the frontier (a recovered snapshot can be a few
+    # slots behind the replayed decisions — proposing at an already-decided
+    # slot would silently lose the request).
+    c_next = jnp.where(is_active, jnp.maximum(c_next, exec_new), c_next)
     ks = jnp.arange(K, dtype=jnp.int32)
     bound = maj_exec + W
     cand_slot_k = c_next[:, None] + ks[None, :]           # [G, K]
     cand_lane = cand_slot_k % W
     lane_busy = jnp.take_along_axis(c_prop_slot != NULL, cand_lane, axis=1)
+    dec_at_cand = jnp.take_along_axis(dec_slot, cand_lane, axis=1)
     can_k = (
         may_admit[:, None] & (no_stop_before > 0)
         & (req_vid != NULL) & (cand_slot_k < bound[:, None]) & (~lane_busy)
+        & (dec_at_cand != cand_slot_k)   # never re-propose a decided slot
     )
     admit = jnp.cumprod(can_k.astype(jnp.int32), axis=1)  # contiguous prefix
     n_admit = admit.sum(axis=1)                           # [G]
@@ -442,5 +468,7 @@ def step(
         n_admitted=jnp.where(m1, n_admit, 0),
         maj_exec=jnp.where(m1, maj_exec, 0),
         app_hash=new_state.app_hash,
+        acc_new=(m2 & acc_changed).astype(jnp.int32),
+        preempted_vid=jnp.where(m2, preempted_vid, NULL),
     )
     return new_state, outputs

@@ -37,6 +37,26 @@ def stack_states(states: List[EngineState]) -> EngineState:
     return jax.tree.map(lambda *xs: jnp.stack(xs), *states)
 
 
+def build_replica_states(cfg: EngineConfig, coord0=None) -> EngineState:
+    """Stacked [R, ...] states with all groups created full-membership.
+
+    The shared state builder for the bench, the driver entry points, and
+    tests; ``coord0`` defaults to round-robin by group index."""
+    import numpy as np
+
+    from ..ops.engine import init_state
+    from ..ops.lifecycle import create_groups
+
+    G, R = cfg.n_groups, cfg.n_replicas
+    idx = np.arange(G)
+    masks = np.full(G, (1 << R) - 1)
+    coord0 = (idx % R).astype(np.int32) if coord0 is None else coord0
+    return stack_states([
+        create_groups(init_state(cfg), idx, masks, coord0, my_id=rid)
+        for rid in range(R)
+    ])
+
+
 def single_chip_step(cfg: EngineConfig):
     """vmap-over-replicas step on one device.
 

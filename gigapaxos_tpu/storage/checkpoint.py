@@ -1,0 +1,72 @@
+"""Engine-state snapshots: one .npz of the batched arrays + a JSON sidecar.
+
+The reference checkpoints *per group* into SQL tables (``checkpoint`` /
+``prev_checkpoint``, ``SQLPaxosLogger.java:149-152``) because each group
+is an object; here the whole engine is a handful of [G]/[G, W] arrays, so
+a checkpoint is a single bulk snapshot and recovery a single bulk load
+(the SURVEY §7 hard-part (d) answer).  App-level checkpoint strings
+(``Replicable.checkpoint``) ride in the sidecar.  The previous snapshot
+is kept (prev_checkpoint analog) and a torn write is detected via the
+atomic rename of the sidecar — the sidecar is written LAST, so a
+snapshot without a valid sidecar is ignored.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+SNAP = "checkpoint.npz"
+META = "checkpoint.meta.json"
+PREV_SNAP = "prev_checkpoint.npz"
+PREV_META = "prev_checkpoint.meta.json"
+
+
+def save_checkpoint(
+    directory: str,
+    arrays: Dict[str, np.ndarray],
+    meta: Dict[str, Any],
+) -> None:
+    """Atomically persist (arrays, meta), demoting the current pair to prev."""
+    os.makedirs(directory, exist_ok=True)
+    snap = os.path.join(directory, SNAP)
+    metaf = os.path.join(directory, META)
+    # demote current -> prev (both files, meta last so prev stays valid)
+    if os.path.exists(snap) and os.path.exists(metaf):
+        os.replace(snap, os.path.join(directory, PREV_SNAP))
+        os.replace(metaf, os.path.join(directory, PREV_META))
+    tmp = snap + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, snap)
+    tmpm = metaf + ".tmp"
+    with open(tmpm, "w", encoding="utf-8") as f:
+        json.dump(meta, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmpm, metaf)
+
+
+def load_checkpoint(
+    directory: str,
+) -> Optional[Tuple[Dict[str, np.ndarray], Dict[str, Any]]]:
+    """Load the newest valid (arrays, meta) pair; falls back to prev."""
+    for snap_name, meta_name in ((SNAP, META), (PREV_SNAP, PREV_META)):
+        snap = os.path.join(directory, snap_name)
+        metaf = os.path.join(directory, meta_name)
+        if not (os.path.exists(snap) and os.path.exists(metaf)):
+            continue
+        try:
+            with open(metaf, "r", encoding="utf-8") as f:
+                meta = json.load(f)
+            with np.load(snap) as z:
+                arrays = {k: z[k] for k in z.files}
+            return arrays, meta
+        except Exception:
+            continue  # torn/corrupt: try prev
+    return None

@@ -1,0 +1,182 @@
+"""Append-only CRC-framed block journal with rotation and GC.
+
+Plays the role of the reference's journal files
+(``SQLPaxosLogger.Journaler``, ``SQLPaxosLogger.java:685-711``: dir
+``paxos_journal.*``, 64MB rotation, GC below the checkpoint) — but the
+record unit is a *block of packed int32 columns* covering many groups at
+once (one ``np.ndarray.tobytes`` per engine step), not one serialized
+message per paxos instance.
+
+Wire format per block (little-endian):
+    magic:u32  type:u8  n_rows:u32  payload_len:u32  crc32(payload):u32
+    payload bytes
+A torn tail (partial header/payload or CRC mismatch) terminates a scan
+cleanly — everything before it is valid (append-only + single writer).
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+import struct
+import zlib
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+MAGIC = 0x47504A4C  # "GPJL"
+_HDR = struct.Struct("<IBIII")
+
+FILE_PREFIX = "journal_"
+FILE_SUFFIX = ".bin"
+
+
+class BlockType(enum.IntEnum):
+    ACCEPTS = 1     # cols: group, slot, ballot, vid
+    DECISIONS = 2   # cols: group, slot, vid
+    CREATE = 3      # cols: group, member_mask, version, coord0
+    PAYLOADS = 4    # raw bytes (host arena spill: vid -> request payloads)
+    PAUSE = 5       # raw bytes (packed rows of paused groups)
+    KILL = 6        # cols: group
+    CHECKPOINT = 7  # raw bytes (json marker: snapshot name + journal pos)
+
+
+def _file_name(idx: int) -> str:
+    return f"{FILE_PREFIX}{idx:08d}{FILE_SUFFIX}"
+
+
+def _file_idx(name: str) -> Optional[int]:
+    if name.startswith(FILE_PREFIX) and name.endswith(FILE_SUFFIX):
+        try:
+            return int(name[len(FILE_PREFIX):-len(FILE_SUFFIX)])
+        except ValueError:
+            return None
+    return None
+
+
+class Journal:
+    """Single-writer append-only journal over rotating files in a dir."""
+
+    def __init__(
+        self,
+        directory: str,
+        max_file_size: int = 64 * 1024 * 1024,  # MAX_LOG_FILE_SIZE analog
+        sync: bool = False,                      # FLUSH/SYNC flag analog
+    ):
+        self.dir = directory
+        self.max_file_size = max_file_size
+        self.sync = sync
+        os.makedirs(directory, exist_ok=True)
+        existing = self.file_indices()
+        self._cur_idx = existing[-1] if existing else 0
+        path = os.path.join(self.dir, _file_name(self._cur_idx))
+        # A crash can leave a torn block at the tail; appending after it
+        # would orphan every later block (scans stop at the tear), so cut
+        # back to the last valid block boundary before appending.
+        self._truncate_torn_tail(path)
+        self._fh = open(path, "ab")
+
+    @staticmethod
+    def _truncate_torn_tail(path: str) -> None:
+        if not os.path.exists(path):
+            return
+        valid_end = 0
+        with open(path, "rb") as f:
+            while True:
+                hdr = f.read(_HDR.size)
+                if len(hdr) < _HDR.size:
+                    break
+                magic, _btype, _n, plen, crc = _HDR.unpack(hdr)
+                if magic != MAGIC:
+                    break
+                payload = f.read(plen)
+                if len(payload) < plen or zlib.crc32(payload) != crc:
+                    break
+                valid_end = f.tell()
+        if valid_end < os.path.getsize(path):
+            with open(path, "r+b") as f:
+                f.truncate(valid_end)
+
+    # ---- write ---------------------------------------------------------
+    def append(self, btype: BlockType, payload: bytes, n_rows: int = 0) -> Tuple[int, int]:
+        """Append one block; returns (file_idx, end_offset) after the write."""
+        hdr = _HDR.pack(MAGIC, int(btype), n_rows, len(payload), zlib.crc32(payload))
+        self._fh.write(hdr)
+        self._fh.write(payload)
+        self._fh.flush()
+        if self.sync:
+            os.fsync(self._fh.fileno())
+        if self._fh.tell() >= self.max_file_size:
+            self._rotate()
+            return (self._cur_idx, 0)
+        return (self._cur_idx, self._fh.tell())
+
+    def append_columns(self, btype: BlockType, cols: List[np.ndarray]) -> Tuple[int, int]:
+        """Append equal-length int32 columns as one packed block."""
+        n = len(cols[0])
+        mat = np.stack([np.asarray(c, np.int32) for c in cols], axis=1)
+        return self.append(btype, mat.tobytes(), n_rows=n)
+
+    def _rotate(self) -> None:
+        self._fh.close()
+        self._cur_idx += 1
+        path = os.path.join(self.dir, _file_name(self._cur_idx))
+        self._fh = open(path, "ab")
+
+    @property
+    def position(self) -> Tuple[int, int]:
+        return (self._cur_idx, self._fh.tell())
+
+    # ---- read ----------------------------------------------------------
+    def file_indices(self) -> List[int]:
+        idxs = sorted(
+            i for n in os.listdir(self.dir)
+            if (i := _file_idx(n)) is not None
+        )
+        return idxs
+
+    def scan(
+        self, from_file: int = 0, from_offset: int = 0
+    ) -> Iterator[Tuple[BlockType, bytes, int, Tuple[int, int]]]:
+        """Yield (type, payload, n_rows, (file_idx, end_offset)) from the
+        given position; stops cleanly at a torn/corrupt tail."""
+        self._fh.flush()
+        for idx in self.file_indices():
+            if idx < from_file:
+                continue
+            path = os.path.join(self.dir, _file_name(idx))
+            with open(path, "rb") as f:
+                if idx == from_file and from_offset:
+                    f.seek(from_offset)
+                while True:
+                    pos = f.tell()
+                    hdr = f.read(_HDR.size)
+                    if len(hdr) < _HDR.size:
+                        break
+                    magic, btype, n_rows, plen, crc = _HDR.unpack(hdr)
+                    if magic != MAGIC:
+                        return  # corrupt: stop the whole scan
+                    payload = f.read(plen)
+                    if len(payload) < plen or zlib.crc32(payload) != crc:
+                        return  # torn tail
+                    yield BlockType(btype), payload, n_rows, (idx, pos + _HDR.size + plen)
+
+    @staticmethod
+    def columns(payload: bytes, n_rows: int, n_cols: int) -> np.ndarray:
+        """Decode a packed column block back to an [n_rows, n_cols] array."""
+        return np.frombuffer(payload, np.int32).reshape(n_rows, n_cols)
+
+    # ---- GC ------------------------------------------------------------
+    def gc_below(self, file_idx: int) -> int:
+        """Delete whole files strictly below file_idx (all their blocks are
+        covered by a checkpoint).  Returns #files removed."""
+        removed = 0
+        for idx in self.file_indices():
+            if idx >= file_idx or idx == self._cur_idx:
+                continue
+            os.remove(os.path.join(self.dir, _file_name(idx)))
+            removed += 1
+        return removed
+
+    def close(self) -> None:
+        self._fh.close()

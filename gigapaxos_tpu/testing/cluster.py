@@ -1,0 +1,131 @@
+"""Loopback manager cluster: N full PaxosManagers (engine + logger + app +
+callbacks) in one process, exchanging blobs and host-channel payloads with
+controllable delivery — the manager-level analog of :mod:`.sim` and of the
+reference's N-nodes-in-one-JVM integration mode (``TESTPaxosNode.java:44``,
+``PaxosManager.java:108-111``)."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..manager import PaxosManager
+from ..ops.engine import Blob, EngineConfig
+
+DELIVER, DROP = 0, 1
+
+
+class ManagerCluster:
+    def __init__(
+        self,
+        cfg: EngineConfig,
+        make_app: Callable[[], object],
+        log_dirs: Optional[List[str]] = None,
+        sync_journal: bool = False,
+        checkpoint_every: int = 400,
+    ):
+        R = cfg.n_replicas
+        self.cfg = cfg
+        self.managers: List[PaxosManager] = [
+            PaxosManager(
+                rid,
+                make_app(),
+                cfg,
+                log_dir=(log_dirs[rid] if log_dirs else None),
+                sync_journal=sync_journal,
+                checkpoint_every=checkpoint_every,
+            )
+            for rid in range(R)
+        ]
+        self.blobs: List[Blob] = [m.blob() for m in self.managers]
+        # host-channel inboxes: (kind, body) per receiver
+        self.inboxes: List[List] = [[] for _ in range(R)]
+
+    # ---- lifecycle across the cluster ---------------------------------
+    def create(self, name: str, members: Optional[List[int]] = None,
+               initial_state: Optional[str] = None) -> int:
+        members = list(range(self.cfg.n_replicas)) if members is None else members
+        row = self.managers[members[0]].default_row_for(name)
+        for m in self.managers:
+            m.create_paxos_instance(
+                name, members, initial_state=initial_state, row=row
+            )
+        self.blobs = [m.blob() for m in self.managers]
+        return row
+
+    # ---- client entry ---------------------------------------------------
+    def submit(self, name: str, value: str, entry: int = 0,
+               callback=None, stop: bool = False) -> Optional[int]:
+        return self.managers[entry].propose(
+            name, value, callback=callback, stop=stop
+        )
+
+    # ---- the cluster tick ----------------------------------------------
+    def step_all(self, delivery: Optional[np.ndarray] = None,
+                 want_coord: Optional[Dict[int, np.ndarray]] = None) -> None:
+        R = self.cfg.n_replicas
+        if delivery is None:
+            delivery = np.full((R, R), DELIVER)
+        want_coord = want_coord or {}
+
+        # deliver host-channel messages that arrived last round
+        for i in range(R):
+            inbox, self.inboxes[i] = self.inboxes[i], []
+            for kind, body in inbox:
+                self.managers[i].on_host_message(kind, body)
+
+        new_blobs: List[Blob] = list(self.blobs)
+        deltas = []
+        for i in range(R):
+            heard = np.zeros(R, bool)
+            rows = []
+            for j in range(R):
+                live = i == j or delivery[i, j] == DELIVER
+                heard[j] = live
+                rows.append(self.blobs[j] if live else self.blobs[i])
+            gathered = jax.tree.map(lambda *xs: jnp.stack(xs), *rows)
+            blob, delta = self.managers[i].tick(
+                gathered, heard, want_coord.get(i)
+            )
+            new_blobs[i] = blob
+            deltas.append(delta)
+        self.blobs = new_blobs
+
+        # route host-channel traffic over live links for NEXT round
+        for i in range(R):
+            delta = deltas[i]
+            if delta["arena"]:
+                for j in range(R):
+                    if j != i and delivery[j, i] == DELIVER:
+                        self.inboxes[j].append(("payloads", delta))
+            mgr = self.managers[i]
+            fwd, mgr.forward_out = mgr.forward_out, []
+            for dst, kind, body in fwd:
+                if dst == i:
+                    mgr.on_host_message(kind, body)
+                elif dst == -1:  # broadcast (e.g. payload pulls)
+                    for j in range(R):
+                        if j != i and delivery[j, i] == DELIVER:
+                            self.inboxes[j].append((kind, body))
+                elif 0 <= dst < R and delivery[dst, i] == DELIVER:
+                    self.inboxes[dst].append((kind, body))
+
+    def run(self, n_steps: int, **kw) -> None:
+        for _ in range(n_steps):
+            self.step_all(**kw)
+
+    # ---- inspection -----------------------------------------------------
+    def frontiers(self) -> np.ndarray:
+        return np.stack(
+            [np.asarray(m.state.exec_slot) for m in self.managers]
+        )
+
+    def app_exec(self) -> np.ndarray:
+        return np.stack([m.app_exec_slot for m in self.managers])
+
+    def close(self) -> None:
+        for m in self.managers:
+            m.close()

@@ -6,7 +6,7 @@ app state at identical frontiers, ``TESTPaxosMain.assertRSMInvariant``),
 decision agreement, and ballot/frontier monotonicity under random message
 schedules — the highest-risk properties of the vectorized design.
 
-All clusters share ONE EngineConfig (G=8, W=8, K=4, R=3) so the whole suite
+All clusters share ONE EngineConfig (G=6, W=8, K=4, R=3) so the whole suite
 reuses a single compiled step executable (``my_id`` is traced, not static).
 """
 

@@ -7,28 +7,16 @@ import jax.numpy as jnp
 import numpy as np
 
 from gigapaxos_tpu.ops.ballot import NULL, ballot_coord
-from gigapaxos_tpu.ops.engine import EngineConfig, init_state
-from gigapaxos_tpu.ops.lifecycle import create_groups, initial_coordinator
+from gigapaxos_tpu.ops.engine import EngineConfig
 from gigapaxos_tpu.parallel.mesh import make_mesh, pick_mesh_shape
 from gigapaxos_tpu.parallel.spmd import (
+    build_replica_states,
     replicate_inputs,
     single_chip_step,
     spmd_step,
-    stack_states,
 )
 
-
-def build_states(cfg, n_groups=None):
-    n = cfg.n_groups if n_groups is None else n_groups
-    idx = np.arange(n)
-    masks = np.full(n, (1 << cfg.n_replicas) - 1)
-    coord0 = initial_coordinator(idx, masks)
-    states = []
-    for rid in range(cfg.n_replicas):
-        states.append(
-            create_groups(init_state(cfg), idx, masks, coord0, my_id=rid)
-        )
-    return stack_states(states)
+build_states = build_replica_states
 
 
 def drive(step_fn, states, cfg, n_steps, vid0=1):
