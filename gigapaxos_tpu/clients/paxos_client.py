@@ -1,0 +1,205 @@
+"""PaxosClientAsync — minimal async client speaking request frames to
+paxos servers.
+
+Ref: ``PaxosClientAsync.java:47-95`` — callback table in a GC'd map with
+8s timeout, requests sent to a random/chosen server; responses matched by
+request id.  Retransmission with the same request id is safe end-to-end:
+servers answer duplicates from the response cache (exactly-once).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import struct
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..net.codec import decode_json, decode_kind, encode_json
+from ..net.transport import MAGIC, _HDR
+
+CALLBACK_TIMEOUT_S = 8.0  # PaxosClientAsync callback GC timeout analog
+
+
+class PaxosClientAsync:
+    def __init__(self, servers: List[Tuple[str, int]], my_tag: int = -1):
+        self.servers = list(servers)
+        self.my_tag = my_tag
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self._loop.run_forever, name="paxos-client", daemon=True
+        )
+        self._thread.start()
+        self._conns: Dict[int, Tuple[asyncio.StreamReader, asyncio.StreamWriter]] = {}
+        self._callbacks: Dict[int, Tuple[float, Callable]] = {}
+        # client ids live in [2^53, 2^62): disjoint from server-minted ids
+        # (namespaced vids < 2^31), collision odds across clients
+        # negligible — the reference uses random 63-bit ids the same way
+        # (RequestPacket.java:83)
+        self._next_id = random.randrange(1 << 53, 1 << 62)
+        self._lock = threading.Lock()
+
+    # ---- public API ----------------------------------------------------
+    def send_request(
+        self,
+        name: str,
+        value: str,
+        callback: Optional[Callable] = None,
+        server: Optional[int] = None,
+        stop: bool = False,
+        request_id: Optional[int] = None,
+    ) -> int:
+        """Fire a request; returns its request id (for retransmission)."""
+        with self._lock:
+            if request_id is None:
+                self._next_id += 1
+                request_id = self._next_id
+            if callback is not None:
+                self._callbacks[request_id] = (time.time(), callback)
+        idx = random.randrange(len(self.servers)) if server is None else server
+        body = {"name": name, "value": value,
+                "request_id": request_id, "stop": stop}
+        frame = encode_json("client_request", self.my_tag, body)
+        asyncio.run_coroutine_threadsafe(
+            self._send(idx, frame), self._loop
+        )
+        return request_id
+
+    def send_request_sync(
+        self,
+        name: str,
+        value: str,
+        timeout: float = 10.0,
+        server: Optional[int] = None,
+        stop: bool = False,
+        retransmit_every: float = 1.0,
+    ) -> Optional[str]:
+        """Blocking convenience: retransmits (same id, rotating servers)
+        until a response arrives or timeout."""
+        ev = threading.Event()
+        out: Dict[str, Optional[str]] = {}
+
+        def cb(rid, resp):
+            out["resp"] = resp
+            ev.set()
+
+        rid = self.send_request(name, value, cb, server=server, stop=stop)
+        deadline = time.time() + timeout
+        attempt = 0
+        while not ev.wait(retransmit_every):
+            if time.time() > deadline:
+                with self._lock:
+                    self._callbacks.pop(rid, None)
+                return None
+            attempt += 1
+            nxt = (server if server is not None else 0) + attempt
+            with self._lock:
+                self._callbacks[rid] = (time.time(), cb)
+            self.send_request(
+                name, value, cb,
+                server=nxt % len(self.servers), request_id=rid,
+            )
+        return out.get("resp")
+
+    # ---- admin helpers --------------------------------------------------
+    def admin_sync(self, server: int, body: Dict, timeout: float = 5.0) -> Optional[Dict]:
+        fut_box: Dict[str, Dict] = {}
+        ev = threading.Event()
+        key = f"admin:{body.get('op')}:{body.get('name')}"
+        with self._lock:
+            self._admin_waiters = getattr(self, "_admin_waiters", {})
+            self._admin_waiters[key] = (ev, fut_box)
+        frame = encode_json("admin", self.my_tag, body)
+        asyncio.run_coroutine_threadsafe(self._send(server, frame), self._loop)
+        if ev.wait(timeout):
+            return fut_box.get("resp")
+        return None
+
+    def create_paxos_instance(
+        self, name: str, members: List[int],
+        initial_state: Optional[str] = None, timeout: float = 5.0,
+    ) -> bool:
+        """Create on every server with a creator-chosen row (keeps group
+        rows aligned across replicas — see PaxosManager.default_row_for)."""
+        r = self.admin_sync(0, {"op": "rowfor", "name": name}, timeout)
+        if r is None:
+            return False
+        row = int(r["row"])
+        ok = True
+        for s in range(len(self.servers)):
+            resp = self.admin_sync(s, {
+                "op": "create", "name": name, "members": members,
+                "row": row, "initial_state": initial_state,
+            }, timeout)
+            ok = ok and bool(resp and resp.get("ok"))
+        return ok
+
+    def close(self) -> None:
+        async def _close():
+            for _r, w in self._conns.values():
+                try:
+                    w.close()
+                except Exception:
+                    pass
+
+        try:
+            asyncio.run_coroutine_threadsafe(_close(), self._loop).result(3)
+        except Exception:
+            pass
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=3)
+
+    # ---- internals ------------------------------------------------------
+    async def _send(self, idx: int, frame: bytes) -> None:
+        conn = self._conns.get(idx)
+        if conn is None:
+            host, port = self.servers[idx]
+            try:
+                reader, writer = await asyncio.open_connection(host, port)
+            except OSError:
+                return
+            self._conns[idx] = (reader, writer)
+            self._loop.create_task(self._read_loop(idx, reader))
+            conn = (reader, writer)
+        _r, writer = conn
+        try:
+            writer.write(_HDR.pack(MAGIC, len(frame)) + frame)
+            await writer.drain()
+        except (ConnectionError, OSError):
+            self._conns.pop(idx, None)
+
+    async def _read_loop(self, idx: int, reader: asyncio.StreamReader) -> None:
+        try:
+            while True:
+                hdr = await reader.readexactly(_HDR.size)
+                magic, length = struct.unpack(">II", hdr)
+                if magic != MAGIC:
+                    break
+                payload = await reader.readexactly(length)
+                self._dispatch(payload)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            self._conns.pop(idx, None)
+
+    def _dispatch(self, payload: bytes) -> None:
+        if decode_kind(payload) != "J":
+            return
+        k, _s, body = decode_json(payload)
+        if k == "client_response":
+            rid = int(body["request_id"])
+            with self._lock:
+                ent = self._callbacks.pop(rid, None)
+                # GC stale callbacks while we're here
+                cut = time.time() - CALLBACK_TIMEOUT_S
+                for dead in [r for r, (t, _) in self._callbacks.items() if t < cut]:
+                    del self._callbacks[dead]
+            if ent:
+                ent[1](rid, body.get("response"))
+        elif k == "admin_response":
+            key = f"admin:{body.get('op')}:{body.get('name')}"
+            waiters = getattr(self, "_admin_waiters", {})
+            ent = waiters.pop(key, None)
+            if ent:
+                ev, box = ent
+                box["resp"] = body
+                ev.set()

@@ -1,0 +1,89 @@
+"""FailureDetection — keep-alive pings + vectorized election triggers.
+
+Ref: ``FailureDetection.java:62-79`` — ping period = timeout/2 (default
+node timeout 6s, ``PaxosConfig.java:668``), ``lastHeardFrom`` map, and the
+optimization that *any* traffic counts as heard-from
+(``PaxosInstanceStateMachine.java:884,1002,1167``).  The reference then
+consults ``isNodeUp``/``lastCoordinatorLongDead`` per instance inside
+``checkRunForCoordinator`` (:1962-2072); here that per-group decision is
+one vectorized pass producing the engine's ``want_coord`` mask:
+
+  run for coordinator of group g iff the believed coordinator (ballot
+  coord) is dead AND I am the next-in-line member (round-robin successor,
+  the ``roundRobinCoordinator`` spread rule :2123), OR the coordinator
+  has been dead ~3x the timeout (anyone may run — liveness backstop).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Iterable
+
+import numpy as np
+
+from .ops.ballot import ballot_coord
+
+NODE_TIMEOUT_S = 6.0          # PaxosConfig FAILURE_DETECTION_TIMEOUT analog
+LONG_DEAD_FACTOR = 3.0        # coordinator_failure_detection_timeout = 3x
+
+
+class FailureDetector:
+    def __init__(
+        self,
+        my_id: int,
+        node_ids: Iterable[int],
+        timeout_s: float = NODE_TIMEOUT_S,
+    ):
+        self.my_id = int(my_id)
+        self.timeout_s = timeout_s
+        now = time.time()
+        self.last_heard: Dict[int, float] = {int(n): now for n in node_ids}
+
+    @property
+    def ping_period_s(self) -> float:
+        return self.timeout_s / 2.0
+
+    def heard_from(self, node_id: int) -> None:
+        self.last_heard[int(node_id)] = time.time()
+
+    def is_node_up(self, node_id: int) -> bool:
+        if node_id == self.my_id:
+            return True
+        t = self.last_heard.get(int(node_id))
+        return t is not None and (time.time() - t) < self.timeout_s
+
+    def dead_for(self, node_id: int) -> float:
+        if node_id == self.my_id:
+            return 0.0
+        t = self.last_heard.get(int(node_id))
+        return float("inf") if t is None else time.time() - t
+
+    # ---- vectorized election trigger ----------------------------------
+    def want_coord(
+        self,
+        bal: np.ndarray,          # [G] promised ballots (packed)
+        member_mask: np.ndarray,  # [G]
+        n_replicas: int,
+    ) -> np.ndarray:
+        """[G] bool: should THIS node start an election for each group."""
+        R = n_replicas
+        up = np.array([self.is_node_up(r) for r in range(R)], bool)
+        long_dead = np.array(
+            [self.dead_for(r) > self.timeout_s * LONG_DEAD_FACTOR
+             for r in range(R)], bool,
+        )
+        coord = np.asarray(ballot_coord(np.asarray(bal))) % R
+        coord_down = ~up[coord]
+        coord_long_dead = long_dead[coord]
+        # next-in-line: the cyclically-next member id after the dead coord
+        mask = np.asarray(member_mask)
+        im_member = ((mask >> self.my_id) & 1) == 1
+        next_rr = np.copy(coord)
+        for step in range(1, R + 1):
+            cand = (coord + step) % R
+            is_member = ((mask >> cand) & 1) == 1
+            cand_up = up[cand]
+            pick = (next_rr == coord) & is_member & cand_up
+            next_rr = np.where(pick, cand, next_rr)
+        im_next = next_rr == self.my_id
+        return im_member & coord_down & (im_next | coord_long_dead)

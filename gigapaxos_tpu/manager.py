@@ -33,6 +33,7 @@ The tick cycle (one call to :meth:`tick`):
 from __future__ import annotations
 
 import json
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -116,9 +117,16 @@ class PaxosManager:
         self.arena: Dict[int, str] = {}        # vid -> request payload (json str)
         self.vid_meta: Dict[int, Tuple[int, int]] = {}  # vid -> (entry_replica, request_id)
         self.outstanding = Outstanding()
-        # keyed (entry_replica, request_id): request ids are only unique
-        # per entry node (each node numbers its own client requests)
-        self.response_cache: Dict[Tuple[int, int], Tuple[float, Optional[str]]] = {}
+        # request_id -> (time, response).  Ids are globally unique by
+        # construction: node-minted ids reuse the namespaced vid; client
+        # ids are random 53+ bit (PaxosClientAsync), disjoint ranges.
+        # Consulted at propose (fast dedup) AND at execution (a client
+        # retransmitting to a different entry replica creates a second
+        # proposal for the same logical request; every replica sees the
+        # same decided sequence, so skipping re-execution of a seen id is
+        # deterministic across the group — at-least-once commit,
+        # exactly-once execution; ref: PaxosManager.java:318-346).
+        self.response_cache: Dict[int, Tuple[float, Optional[str]]] = {}
         self._next_counter = 1
         self.queues: Dict[int, List[int]] = {}  # group row -> pending vids
         self.forward_out: List[Tuple[int, str, Dict]] = []  # (dst, kind, body)
@@ -135,6 +143,9 @@ class PaxosManager:
         self.total_executed = 0
         self._slots_since_ckpt = 0
 
+        # serializes self.state replacement between the tick loop and
+        # lifecycle ops arriving on transport threads (create/kill/recover)
+        self._state_lock = threading.RLock()
         self.state: EngineState = init_state(cfg)
         self._recover()
 
@@ -145,7 +156,9 @@ class PaxosManager:
         if self.logger is None:
             return
         seed = {k: np.asarray(v).copy() for k, v in self.state._asdict().items()}
-        rec = self.logger.recover(self.cfg.window, seed_arrays=seed)
+        rec = self.logger.recover(
+            self.cfg.window, seed_arrays=seed, my_id=self.my_id
+        )
         if rec.arrays is None:
             return
         self.state = EngineState(
@@ -158,6 +171,10 @@ class PaxosManager:
             self.vid_meta.setdefault(int(k), (v[0], v[1]))
         self.arena.update(rec.payloads)  # journal blocks are newer
         self.names = {str(k): int(v) for k, v in meta.get("names", {}).items()}
+        journal_inits: Dict[str, Optional[str]] = {}
+        for nm, ent in rec.names.items():  # creates after the checkpoint
+            self.names[nm] = int(ent["row"])
+            journal_inits[nm] = ent.get("init")
         self.row_name = {v: k for k, v in self.names.items()}
         self._next_counter = int(meta.get("next_counter", 1))
         for vid in rec.payloads:
@@ -177,9 +194,13 @@ class PaxosManager:
             self.pending_exec[int(g_str)] = {
                 int(s_): int(v) for s_, v in pend.items()
             }
-        for name, state_str in (meta.get("app_states") or {}).items():
+        app_states = meta.get("app_states") or {}
+        for name, state_str in app_states.items():
             if name in self.names:
                 self.app.restore(name, state_str)
+        for name, init in journal_inits.items():
+            if name not in app_states:
+                self.app.restore(name, init)
         # decisions after the checkpoint replay through the engine (its
         # exec frontier resumes from the snapshot), and the host cursor
         # re-executes them once payloads re-enter via the journal arena.
@@ -212,6 +233,12 @@ class PaxosManager:
         version: int = 0,
         row: Optional[int] = None,
     ) -> bool:
+        with self._state_lock:
+            return self._create_locked(
+                name, members, initial_state, version, row
+            )
+
+    def _create_locked(self, name, members, initial_state, version, row) -> bool:
         if name in self.names:
             return False
         row = self.default_row_for(name) if row is None else int(row)
@@ -237,12 +264,17 @@ class PaxosManager:
             self.logger.log_create(
                 np.array([row]), np.array([mask]),
                 np.array([version]), np.array([coord0]),
+                names=[name], inits=[initial_state],
             )
         if self.my_id in members:
             self.app.restore(name, initial_state)
         return True
 
     def kill(self, name: str) -> bool:
+        with self._state_lock:
+            return self._kill_locked(name)
+
+    def _kill_locked(self, name: str) -> bool:
         row = self.names.pop(name, None)
         if row is None:
             return False
@@ -279,14 +311,11 @@ class PaxosManager:
         if row is None:
             return None
         entry = self.my_id if entry_replica is None else entry_replica
-        request_id = (
-            request_id if request_id is not None else self._next_counter
-        )
-        # exactly-once: a retransmitted request id is answered from the
-        # response cache, not re-executed (PaxosManager.java:318-346)
-        if (entry, request_id) in self.response_cache:
+        # exactly-once fast path: a retransmitted request id is answered
+        # from the response cache, not re-proposed
+        if request_id is not None and request_id in self.response_cache:
             if callback:
-                callback(request_id, self.response_cache[(entry, request_id)][1])
+                callback(request_id, self.response_cache[request_id][1])
             return None
         # vids are GLOBALLY unique (node id in the high bits): they key the
         # cross-replica payload arena, so two nodes must never mint the
@@ -295,6 +324,8 @@ class PaxosManager:
             raise RuntimeError("vid counter space exhausted")
         vid = (self.my_id << VID_NODE_SHIFT) | self._next_counter
         self._next_counter += 1
+        if request_id is None:
+            request_id = vid  # namespaced-unique by construction
         if stop:
             vid |= STOP_BIT
         self.arena[vid] = request_value
@@ -398,11 +429,12 @@ class PaxosManager:
             else jnp.asarray(want_coord, bool)
         )
         t0 = time.perf_counter()
-        new_state, out = _step_jit(
-            self.state, gathered, jnp.asarray(heard),
-            jnp.asarray(req), wc, jnp.int32(self.my_id), cfg=cfg,
-        )
-        self.state = new_state
+        with self._state_lock:
+            new_state, out = _step_jit(
+                self.state, gathered, jnp.asarray(heard),
+                jnp.asarray(req), wc, jnp.int32(self.my_id), cfg=cfg,
+            )
+            self.state = new_state
         DelayProfiler.update_delay("engine_step", time.perf_counter() - t0)
 
         out_np = jax.tree.map(np.asarray, out)
@@ -533,6 +565,17 @@ class PaxosManager:
         if payload is None:
             return False
         entry, request_id = self.vid_meta.get(vid, (-1, vid))
+        if request_id in self.response_cache:
+            # duplicate of an already-executed request (client retransmit
+            # through a different entry replica): skip re-execution on
+            # EVERY replica — deterministic, since all see the same
+            # decided sequence and the same earlier execution.
+            if entry == self.my_id:
+                cb = self.outstanding.pop(request_id)
+                if cb is not None:
+                    cb(request_id, self.response_cache[request_id][1])
+            self.retained[vid] = (g, slot)
+            return True
         req = RequestPacket(
             paxos_id=name or "", request_id=request_id,
             request_value=payload, stop=bool(vid & STOP_BIT),
@@ -552,8 +595,8 @@ class PaxosManager:
         self.total_executed += 1
         self._slots_since_ckpt += 1
         response = getattr(req, "response_value", None)
+        self.response_cache[request_id] = (time.time(), response)
         if entry == self.my_id:
-            self.response_cache[(entry, request_id)] = (time.time(), response)
             cb = self.outstanding.pop(request_id)
             if cb is not None:
                 cb(request_id, response)

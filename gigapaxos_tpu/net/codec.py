@@ -1,0 +1,70 @@
+"""Wire codecs for the host transport.
+
+Two frame families share the TCP substrate (ref: the reference mixes JSON
+and hand-rolled byte layouts on one NIO channel,
+``paxosutil/PaxosPacketDemultiplexerFast.java:1``):
+
+* ``J`` frames — JSON control messages: host-channel deltas, client
+  requests/responses, failure-detection pings, admin ops.
+* ``B`` frames — packed engine blobs: sender id + tick + raw int32 leaf
+  bytes in ``Blob._fields`` order (shapes are static per EngineConfig, so
+  no per-leaf headers are needed — the reference's fixed-layout
+  ``RequestPacket.toBytes`` idea applied to whole state arrays).
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..ops.engine import Blob, EngineConfig
+
+_BHDR = struct.Struct(">cIQ")  # kind, sender, tick
+
+
+def encode_json(kind: str, sender: int, body: Dict) -> bytes:
+    env = {"k": kind, "s": sender, "b": body}
+    return b"J" + json.dumps(env, separators=(",", ":")).encode("utf-8")
+
+
+def decode_kind(payload: bytes) -> str:
+    return payload[:1].decode("ascii", "replace")
+
+
+def decode_json(payload: bytes) -> Tuple[str, int, Dict]:
+    env = json.loads(payload[1:].decode("utf-8"))
+    return env["k"], int(env["s"]), env["b"]
+
+
+def blob_shapes(cfg: EngineConfig):
+    G, W = cfg.n_groups, cfg.window
+    return {
+        name: (G,) if name in ("bal", "exec_slot", "prep_bal", "prop_bal")
+        else (G, W)
+        for name in Blob._fields
+    }
+
+
+def encode_blob(sender: int, tick: int, blob: Blob) -> bytes:
+    parts = [_BHDR.pack(b"B", sender, tick)]
+    for leaf in blob:
+        parts.append(np.asarray(leaf, np.int32).tobytes())
+    return b"".join(parts)
+
+
+def decode_blob(payload: bytes, cfg: EngineConfig) -> Tuple[int, int, Blob]:
+    kind, sender, tick = _BHDR.unpack_from(payload, 0)
+    assert kind == b"B"
+    shapes = blob_shapes(cfg)
+    off = _BHDR.size
+    leaves = []
+    for name in Blob._fields:
+        shape = shapes[name]
+        n = int(np.prod(shape))
+        arr = np.frombuffer(payload, np.int32, count=n, offset=off).reshape(shape)
+        off += n * 4
+        leaves.append(arr)
+    return sender, tick, Blob(*leaves)

@@ -1,0 +1,192 @@
+"""MessageTransport — async TCP message substrate (ref: ``NIOTransport``).
+
+Re-creation of the reference's from-scratch NIO layer
+(``nio/NIOTransport.java:115``: single selector thread, non-blocking
+connect/accept/read/write, per-destination pending-write queues with
+congestion back-pressure, auto-reconnect; wire format = 4-byte magic
+preamble + 4-byte length + payload, ``NIOTransport.java:483-524``) on top
+of one asyncio event loop running in a dedicated thread, so synchronous
+callers (the manager tick loop) can ``send_to_id`` without owning a loop.
+
+Differences by design, not omission: SSL is delegated to asyncio's native
+TLS support (``ssl_context`` arg vs the reference's hand-rolled SSLEngine
+wrapper, ``SSLDataProcessingWorker.java:59``); byte-order and magic match
+no one — this framework's peers only speak to each other.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+MAGIC = 0x47503270  # "GP2p"
+_HDR = struct.Struct(">II")  # magic, payload length
+MAX_PAYLOAD = 256 * 1024 * 1024
+CONGESTION_LIMIT = 4096  # per-peer queued messages before drops (isCongested)
+
+# handler(payload: bytes, sender: (host, port), reply) -> None
+# ``reply(bytes)`` queues a frame back on the SAME connection (needed for
+# client request/response: clients don't listen on a port).
+Handler = Callable[[bytes, Tuple[str, int], Callable[[bytes], None]], None]
+
+
+class MessageTransport:
+    def __init__(
+        self,
+        my_id: int,
+        node_config,
+        handler: Handler,
+        listen_host: Optional[str] = None,
+        listen_port: Optional[int] = None,
+        ssl_context=None,
+    ):
+        self.my_id = int(my_id)
+        self.node_config = node_config
+        self.handler = handler
+        if listen_host is None or listen_port is None:
+            listen_host, listen_port = node_config.get_node_address(my_id)
+        self.listen_host, self.listen_port = listen_host, int(listen_port)
+        self._ssl = ssl_context
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self._loop.run_forever, name=f"transport-{my_id}", daemon=True
+        )
+        self._writers: Dict[Tuple[str, int], asyncio.StreamWriter] = {}
+        self._queues: Dict[Tuple[str, int], asyncio.Queue] = {}
+        self._senders: Dict[Tuple[str, int], asyncio.Task] = {}
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._started = threading.Event()
+        self._stopped = False
+        self.n_sent = 0
+        self.n_rcvd = 0
+        self.n_dropped = 0  # congestion drops (NIOInstrumenter analog)
+
+    # ---- lifecycle -----------------------------------------------------
+    def start(self) -> None:
+        self._thread.start()
+        fut = asyncio.run_coroutine_threadsafe(self._start_server(), self._loop)
+        fut.result(timeout=10)
+        self._started.set()
+
+    async def _start_server(self) -> None:
+        self._server = await asyncio.start_server(
+            self._on_connection, self.listen_host, self.listen_port,
+            ssl=self._ssl,
+        )
+
+    def stop(self) -> None:
+        if self._stopped:
+            return
+        self._stopped = True
+
+        async def _shutdown():
+            if self._server is not None:
+                self._server.close()
+            for task in self._senders.values():
+                task.cancel()
+            for w in self._writers.values():
+                try:
+                    w.close()
+                except Exception:
+                    pass
+
+        try:
+            asyncio.run_coroutine_threadsafe(_shutdown(), self._loop).result(5)
+        except Exception:
+            pass
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=5)
+
+    # ---- receive path --------------------------------------------------
+    async def _on_connection(self, reader: asyncio.StreamReader, writer):
+        peer = writer.get_extra_info("peername") or ("?", 0)
+
+        def reply(payload: bytes) -> None:
+            def _w():
+                try:
+                    writer.write(_HDR.pack(MAGIC, len(payload)) + payload)
+                except Exception:
+                    pass
+            self._loop.call_soon_threadsafe(_w)
+
+        try:
+            while True:
+                hdr = await reader.readexactly(_HDR.size)
+                magic, length = _HDR.unpack(hdr)
+                if magic != MAGIC or length > MAX_PAYLOAD:
+                    break  # protocol violation: drop the connection
+                payload = await reader.readexactly(length)
+                self.n_rcvd += 1
+                try:
+                    self.handler(payload, peer, reply)
+                except Exception:
+                    pass  # handler errors must not kill the read loop
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            writer.close()
+
+    # ---- send path -----------------------------------------------------
+    def send_to_id(self, node_id: int, payload: bytes) -> bool:
+        """Queue for delivery to a node id; False when congested/unknown."""
+        if node_id not in self.node_config:
+            return False
+        return self.send_to_address(
+            self.node_config.get_node_address(node_id), payload
+        )
+
+    def send_to_address(self, addr: Tuple[str, int], payload: bytes) -> bool:
+        if self._stopped:
+            return False
+        addr = (addr[0], int(addr[1]))
+        self._loop.call_soon_threadsafe(self._enqueue, addr, payload)
+        return True
+
+    def _enqueue(self, addr: Tuple[str, int], payload: bytes) -> None:
+        q = self._queues.get(addr)
+        if q is None:
+            q = asyncio.Queue()
+            self._queues[addr] = q
+            self._senders[addr] = self._loop.create_task(self._sender(addr, q))
+        if q.qsize() >= CONGESTION_LIMIT:
+            self.n_dropped += 1  # congestion: drop, like the reference
+            return
+        q.put_nowait(payload)
+
+    def is_congested(self, node_id: int) -> bool:
+        try:
+            addr = self.node_config.get_node_address(node_id)
+        except KeyError:
+            return True
+        q = self._queues.get((addr[0], int(addr[1])))
+        return q is not None and q.qsize() >= CONGESTION_LIMIT
+
+    async def _sender(self, addr: Tuple[str, int], q: asyncio.Queue) -> None:
+        """Per-peer writer with auto-reconnect (pending-writes analog)."""
+        writer: Optional[asyncio.StreamWriter] = None
+        while not self._stopped:
+            payload = await q.get()
+            for _attempt in (0, 1):
+                if writer is None:
+                    try:
+                        _r, writer = await asyncio.open_connection(
+                            addr[0], addr[1], ssl=self._ssl
+                        )
+                        self._writers[addr] = writer
+                    except OSError:
+                        writer = None
+                        await asyncio.sleep(0.05)
+                        continue
+                try:
+                    writer.write(_HDR.pack(MAGIC, len(payload)) + payload)
+                    await writer.drain()
+                    self.n_sent += 1
+                    break
+                except (ConnectionError, OSError):
+                    try:
+                        writer.close()
+                    except Exception:
+                        pass
+                    writer = None  # retry once with a fresh connection

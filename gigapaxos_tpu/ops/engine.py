@@ -325,8 +325,18 @@ def step(
     preempt = (phase != IDLE) & (new_bal > me_coord)
     phase = jnp.where(preempt, IDLE, phase)
 
-    # Election start (checkRunForCoordinator, :1962-2072): host FD says go.
-    start = want_coord & (phase == IDLE) & (~inert) & (stopped == 0)
+    # Election start (checkRunForCoordinator, :1962-2072): host FD says go,
+    # OR the promise ballot names ME as coordinator while I hold no
+    # coordinator state — the "I'm ballot-coordinator but not running"
+    # eligibility clause (:1992-2006).  This happens after crash recovery:
+    # replayed accepts restore the promise ballot, but coordinator state is
+    # volatile (HotRestore-only in the reference too), so without this rule
+    # the group wedges — the failure detector sees the named coordinator
+    # alive and never fires.
+    from .ballot import COORD_MASK
+
+    orphaned = ((new_bal & COORD_MASK) == my_id) & (new_bal != NULL)
+    start = (want_coord | orphaned) & (phase == IDLE) & (~inert) & (stopped == 0)
     start_bal = encode_ballot(ballot_num(new_bal) + 1, my_id)
     c_bal = jnp.where(start, start_bal, me_coord)
     phase = jnp.where(start, PREPARING, phase)

@@ -1,0 +1,208 @@
+"""PaxosServer — a standalone replica node over real sockets.
+
+Ref: ``gigapaxos/PaxosServer.java:135`` (boot a PaxosManager behind NIO
+transport).  Each server runs:
+
+* a :class:`~gigapaxos_tpu.manager.PaxosManager` (engine + durability +
+  app execution),
+* a :class:`~gigapaxos_tpu.net.transport.MessageTransport` carrying blob
+  frames (the consensus state exchange — loopback/DCN stand-in for the
+  ICI all_gather), host-channel JSON (payload replication, forwards,
+  pulls), failure-detection pings, client requests, and admin ops,
+* a :class:`~gigapaxos_tpu.failure_detection.FailureDetector` driving the
+  engine's vectorized election mask,
+* a tick-loop thread (the RequestBatcher/BatchedLogger thread-pipeline
+  analog collapsed into one cadence).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .failure_detection import FailureDetector
+from .manager import PaxosManager
+from .net.codec import (
+    decode_blob,
+    decode_json,
+    decode_kind,
+    encode_blob,
+    encode_json,
+)
+from .net.node_config import NodeConfig
+from .net.transport import MessageTransport
+from .ops.engine import Blob, EngineConfig
+
+
+class PaxosServer:
+    def __init__(
+        self,
+        my_id: int,
+        node_config: NodeConfig,
+        app,
+        cfg: EngineConfig,
+        log_dir: Optional[str] = None,
+        tick_interval: float = 0.01,
+        fd_timeout_s: float = 2.0,
+    ):
+        self.my_id = int(my_id)
+        self.node_config = node_config
+        self.cfg = cfg
+        self.manager = PaxosManager(my_id, app, cfg, log_dir=log_dir)
+        self.transport = MessageTransport(my_id, node_config, self._on_message)
+        self.fd = FailureDetector(my_id, node_config.get_node_ids(), fd_timeout_s)
+        self.tick_interval = tick_interval
+        self._peer_blobs: Dict[int, Blob] = {}
+        self._blob_lock = threading.Lock()
+        self._tick = 0
+        self._last_ping = 0.0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name=f"paxos-server-{my_id}", daemon=True
+        )
+
+    # ---- lifecycle -----------------------------------------------------
+    def start(self) -> None:
+        self.transport.start()
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=10)
+        self.transport.stop()
+        self.manager.close()
+
+    # ---- message ingress (demultiplexer analog) ------------------------
+    def _on_message(self, payload: bytes, peer: Tuple[str, int], reply) -> None:
+        kind = decode_kind(payload)
+        if kind == "B":
+            sender, _tick, blob = decode_blob(payload, self.cfg)
+            with self._blob_lock:
+                self._peer_blobs[sender] = blob
+            self.fd.heard_from(sender)
+            return
+        k, sender, body = decode_json(payload)
+        if sender >= 0:
+            self.fd.heard_from(sender)
+        if k in ("payloads", "forward", "need_payloads"):
+            self.manager.on_host_message(k, body)
+        elif k == "fd_ping":
+            pass  # hearing it is the point (any traffic counts as alive)
+        elif k == "client_request":
+            self._on_client_request(body, reply)
+        elif k == "admin":
+            self._on_admin(body, reply)
+
+    def _on_client_request(self, body: Dict, reply) -> None:
+        request_id = int(body["request_id"])
+
+        def cb(rid, response):
+            reply(encode_json("client_response", self.my_id, {
+                "request_id": rid, "response": response,
+                "name": body["name"],
+            }))
+
+        vid = self.manager.propose(
+            body["name"], body.get("value", ""),
+            callback=cb, stop=bool(body.get("stop", False)),
+            request_id=request_id,
+        )
+        if vid is None and request_id not in self.manager.response_cache:
+            reply(encode_json("client_response", self.my_id, {
+                "request_id": request_id, "response": None,
+                "name": body["name"], "error": "unknown_name",
+            }))
+
+    def _on_admin(self, body: Dict, reply) -> None:
+        op = body.get("op")
+        if op == "rowfor":
+            reply(encode_json("admin_response", self.my_id, {
+                "op": op, "name": body["name"],
+                "row": self.manager.default_row_for(body["name"]),
+            }))
+        elif op == "create":
+            ok = self.manager.create_paxos_instance(
+                body["name"], list(body["members"]),
+                initial_state=body.get("initial_state"),
+                row=int(body["row"]),
+            )
+            reply(encode_json("admin_response", self.my_id, {
+                "op": op, "name": body["name"], "ok": bool(ok),
+            }))
+        elif op == "kill":
+            ok = self.manager.kill(body["name"])
+            reply(encode_json("admin_response", self.my_id, {
+                "op": op, "name": body["name"], "ok": bool(ok),
+            }))
+
+    # ---- the tick loop -------------------------------------------------
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            t0 = time.perf_counter()
+            try:
+                self.tick_once()
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+            dt = time.perf_counter() - t0
+            sleep = self.tick_interval - dt
+            if sleep > 0:
+                self._stop.wait(sleep)
+
+    def tick_once(self) -> None:
+        R = self.cfg.n_replicas
+        my_blob = self.manager.blob()
+        with self._blob_lock:
+            peer_blobs = dict(self._peer_blobs)
+        rows, heard = [], np.zeros(R, bool)
+        for r in range(R):
+            if r == self.my_id:
+                rows.append(my_blob)
+                heard[r] = True
+            elif r in peer_blobs:
+                rows.append(jax.tree.map(jnp.asarray, peer_blobs[r]))
+                heard[r] = True
+            else:
+                rows.append(my_blob)
+        gathered = jax.tree.map(lambda *xs: jnp.stack(xs), *rows)
+        want = self.fd.want_coord(
+            np.asarray(self.manager.state.bal),
+            np.asarray(self.manager.state.member_mask),
+            R,
+        )
+        blob, delta = self.manager.tick(gathered, heard, want)
+        self._tick += 1
+
+        # publish: blob to every peer (the all_gather stand-in)
+        blob_frame = encode_blob(self.my_id, self._tick, jax.tree.map(np.asarray, blob))
+        peers = [r for r in self.node_config.get_node_ids() if r != self.my_id]
+        for r in peers:
+            self.transport.send_to_id(r, blob_frame)
+        if delta["arena"] or delta.get("app_exec"):
+            frame = encode_json("payloads", self.my_id, delta)
+            for r in peers:
+                self.transport.send_to_id(r, frame)
+        fwd, self.manager.forward_out = self.manager.forward_out, []
+        for dst, k, body in fwd:
+            frame = encode_json(k, self.my_id, body)
+            if dst == -1:
+                for r in peers:
+                    self.transport.send_to_id(r, frame)
+            elif dst == self.my_id:
+                self.manager.on_host_message(k, body)
+            else:
+                self.transport.send_to_id(dst, frame)
+
+        # failure-detection pings at period = timeout/2
+        now = time.time()
+        if now - self._last_ping > self.fd.ping_period_s:
+            self._last_ping = now
+            ping = encode_json("fd_ping", self.my_id, {"t": now})
+            for r in peers:
+                self.transport.send_to_id(r, ping)

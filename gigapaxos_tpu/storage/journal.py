@@ -39,6 +39,10 @@ class BlockType(enum.IntEnum):
     PAUSE = 5       # raw bytes (packed rows of paused groups)
     KILL = 6        # cols: group
     CHECKPOINT = 7  # raw bytes (json marker: snapshot name + journal pos)
+    NAMES = 8       # raw bytes (json [{row, name, version, init}] — the
+    #                 name->row map + initial app state of CREATE blocks;
+    #                 names are host-side strings so they can't ride the
+    #                 packed int32 CREATE columns)
 
 
 def _file_name(idx: int) -> str:

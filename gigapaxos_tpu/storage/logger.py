@@ -36,10 +36,12 @@ class RecoveredState:
         arrays: Optional[Dict[str, np.ndarray]],
         meta: Dict[str, Any],
         payloads: Dict[int, str],
+        names: Dict[str, Dict[str, Any]],
     ):
         self.arrays = arrays          # None => fresh start
         self.meta = meta
         self.payloads = payloads      # vid -> request string (host arena)
+        self.names = names            # name -> {row, version, init} (post-ckpt creates)
 
 
 class PaxosLogger:
@@ -63,11 +65,23 @@ class PaxosLogger:
         if len(groups):
             self.journal.append_columns(BlockType.DECISIONS, [groups, slots, vids])
 
-    def log_create(self, groups, masks, versions, coords) -> None:
+    def log_create(
+        self, groups, masks, versions, coords, names=None, inits=None
+    ) -> None:
         if len(groups):
             self.journal.append_columns(
                 BlockType.CREATE, [groups, masks, versions, coords]
             )
+            if names is not None:
+                rows = [
+                    {"row": int(g), "name": n, "version": int(v),
+                     "init": (None if inits is None else inits[i])}
+                    for i, (g, n, v) in enumerate(zip(groups, names, versions))
+                ]
+                self.journal.append(
+                    BlockType.NAMES,
+                    json.dumps(rows, separators=(",", ":")).encode("utf-8"),
+                )
 
     def log_kill(self, groups) -> None:
         if len(groups):
@@ -101,6 +115,7 @@ class PaxosLogger:
         self,
         window: int,
         seed_arrays: Optional[Dict[str, np.ndarray]] = None,
+        my_id: Optional[int] = None,
     ) -> RecoveredState:
         """Load newest snapshot, then roll every later block forward into
         the arrays.  ``seed_arrays`` (a fresh init_state as numpy, from the
@@ -116,11 +131,16 @@ class PaxosLogger:
             arrays = {k: v.copy() for k, v in arrays_ro.items()}
             from_file, from_off = meta.get("journal_pos", [0, 0])
         payloads: Dict[int, str] = {}
+        names: Dict[str, Dict[str, Any]] = {}
         for btype, payload, n_rows, _pos in self.journal.scan(from_file, from_off):
             if btype == BlockType.PAYLOADS:
                 payloads.update(
                     {int(k): v for k, v in json.loads(payload.decode("utf-8")).items()}
                 )
+                continue
+            if btype == BlockType.NAMES:
+                for ent in json.loads(payload.decode("utf-8")):
+                    names[ent["name"]] = ent
                 continue
             if btype == BlockType.CHECKPOINT:
                 continue
@@ -130,8 +150,8 @@ class PaxosLogger:
                         "journal has blocks but no checkpoint and no seed_arrays"
                     )
                 arrays = {k: v.copy() for k, v in seed_arrays.items()}
-            self._apply(arrays, btype, payload, n_rows, window)
-        return RecoveredState(arrays, meta, payloads)
+            self._apply(arrays, btype, payload, n_rows, window, my_id)
+        return RecoveredState(arrays, meta, payloads, names)
 
     @staticmethod
     def _apply(
@@ -140,6 +160,7 @@ class PaxosLogger:
         payload: bytes,
         n_rows: int,
         window: int,
+        my_id: Optional[int] = None,
     ) -> None:
         """Vectorized rollforward of one block into the state arrays.
 
@@ -162,6 +183,16 @@ class PaxosLogger:
                 arrays[name][g] = NULL
             arrays["app_hash"][g] = 0
             arrays["n_execd"][g] = 0
+            # the initial coordinator must resume ACTIVE (create_groups
+            # semantics) — otherwise nobody proposes and the failure
+            # detector never fires (the coordinator is alive, just idle)
+            if my_id is not None and "c_phase" in arrays:
+                im_coord = coord0 == my_id
+                arrays["c_phase"][g] = np.where(im_coord, 2, 0)  # ACTIVE/IDLE
+                arrays["c_bal"][g] = np.where(im_coord, coord0, NULL)
+                arrays["c_next_slot"][g] = 0
+                arrays["c_prop_vid"][g] = NULL
+                arrays["c_prop_slot"][g] = NULL
         elif btype == BlockType.ACCEPTS:
             m = Journal.columns(payload, n_rows, 4)
             g, slot, bal, vid = m.T
