@@ -18,27 +18,78 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 import json
 import os
+import subprocess
 import sys
 import time
 
 NORTH_STAR = 10_000_000.0  # decisions/s, BASELINE.json
 
 
+def probe_tpu(timeout_s: float) -> tuple:
+    """Probe whether the TPU backend can actually initialize — in a
+    SUBPROCESS, because a broken tunnel makes backend init hang forever
+    (not raise), and an in-process hang can't be timed out.  Returns
+    (platform or None, error string)."""
+    code = (
+        "import jax; d = jax.devices(); "
+        "import jax.numpy as jnp; "
+        "jnp.ones((8, 8)).sum().block_until_ready(); "
+        "print('PLATFORM=' + d[0].platform)"
+    )
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"TPU backend init hung > {timeout_s:.0f}s (tunnel down?)"
+    for line in r.stdout.splitlines():
+        if line.startswith("PLATFORM="):
+            return line.split("=", 1)[1], ""
+    return None, (r.stderr or "no output").strip()[-2000:]
+
+
 def main() -> None:
+    # Decide the platform BEFORE any in-process backend init.  The env pins
+    # JAX_PLATFORMS=axon via a site hook; if the chip can't init we must say
+    # so loudly and fall back with a distinct marker — never silently.
+    env_platforms = os.environ.get("JAX_PLATFORMS", "")
+    fallback = False
+    if env_platforms and env_platforms != "cpu":
+        probe_timeout = float(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "300"))
+        platform_probe, err = probe_tpu(probe_timeout)
+        if platform_probe is None:
+            print(
+                f"BENCH WARNING: TPU ({env_platforms}) unavailable: {err}\n"
+                "BENCH WARNING: falling back to CPU — this number is NOT a "
+                "TPU measurement.",
+                file=sys.stderr, flush=True,
+            )
+            fallback = True
+            os.environ["JAX_PLATFORMS"] = "cpu"
+
     import jax
 
-    # A site hook may override jax_platforms via jax.config at startup; honor
-    # an explicit JAX_PLATFORMS env var over that (e.g. JAX_PLATFORMS=cpu for
-    # a local smoke run without the TPU tunnel).
-    env_platforms = os.environ.get("JAX_PLATFORMS")
-    if env_platforms:
-        jax.config.update("jax_platforms", env_platforms)
+    if fallback or env_platforms == "cpu":
+        jax.config.update("jax_platforms", "cpu")
     try:
         devs = jax.devices()
-    except Exception:
+    except Exception as e:
+        # a config-level platform pin (site hook) with a broken backend can
+        # still raise here even when the env var was unset — fall back
+        # loudly rather than dying without printing the JSON line
+        print(
+            f"BENCH WARNING: backend init failed in-process: {e!r}\n"
+            "BENCH WARNING: falling back to CPU — this number is NOT a "
+            "TPU measurement.",
+            file=sys.stderr, flush=True,
+        )
+        fallback = True
         jax.config.update("jax_platforms", "cpu")
         devs = jax.devices()
     platform = devs[0].platform
+    if fallback:
+        platform = "cpu-fallback"
 
     import jax.numpy as jnp
 
@@ -47,7 +98,8 @@ def main() -> None:
     from gigapaxos_tpu.parallel.spmd import build_replica_states, single_chip_step
 
     # ~1M groups on TPU HBM; smaller on CPU fallback so the line still prints.
-    G = 1_048_576 if platform != "cpu" else 8_192
+    on_cpu = platform.startswith("cpu")
+    G = 8_192 if on_cpu else 1_048_576
     W, K, R = 8, 4, 3
     cfg = EngineConfig(n_groups=G, window=W, req_lanes=K, n_replicas=R)
     states = build_replica_states(cfg)

@@ -130,6 +130,7 @@ class PaxosManager:
         self._next_counter = 1
         self.queues: Dict[int, List[int]] = {}  # group row -> pending vids
         self.forward_out: List[Tuple[int, str, Dict]] = []  # (dst, kind, body)
+        self._fired_callbacks: List[Tuple[Callable, int, Optional[str]]] = []
         self.app_exec_slot = np.zeros(G, np.int64)  # host app cursor per group
         self.pending_exec: Dict[int, Dict[int, int]] = {}  # g -> slot -> vid
         # executed payloads retained for straggler pulls until every live
@@ -306,33 +307,44 @@ class PaxosManager:
         entry_replica: Optional[int] = None,
     ) -> Optional[int]:
         """Enqueue a request for consensus; returns the assigned vid (or
-        None if the name is unknown here)."""
-        row = self.names.get(name)
-        if row is None:
-            return None
-        entry = self.my_id if entry_replica is None else entry_replica
-        # exactly-once fast path: a retransmitted request id is answered
-        # from the response cache, not re-proposed
-        if request_id is not None and request_id in self.response_cache:
+        None if the name is unknown here).
+
+        Thread-safe: callable from transport threads concurrently with the
+        tick loop (the lock covers the vid counter and the queue/arena
+        handoff — vids key the cross-replica payload arena, so two threads
+        must never mint the same vid for different requests).  User
+        callbacks never run under the lock (a blocking callback must not
+        stall the tick loop or other transport threads)."""
+        cached_hit = False
+        cached_response = None
+        with self._state_lock:
+            row = self.names.get(name)
+            if row is None:
+                return None
+            entry = self.my_id if entry_replica is None else entry_replica
+            # exactly-once fast path: a retransmitted request id is answered
+            # from the response cache, not re-proposed
+            if request_id is not None and request_id in self.response_cache:
+                cached_hit = True
+                cached_response = self.response_cache[request_id][1]
+            else:
+                if self._next_counter > VID_COUNTER_MASK:
+                    raise RuntimeError("vid counter space exhausted")
+                vid = (self.my_id << VID_NODE_SHIFT) | self._next_counter
+                self._next_counter += 1
+                if request_id is None:
+                    request_id = vid  # namespaced-unique by construction
+                if stop:
+                    vid |= STOP_BIT
+                self.arena[vid] = request_value
+                self.vid_meta[vid] = (entry, request_id)
+                if callback is not None:
+                    self.outstanding.put(request_id, callback)
+                self.queues.setdefault(row, []).append(vid)
+        if cached_hit:
             if callback:
-                callback(request_id, self.response_cache[request_id][1])
+                callback(request_id, cached_response)
             return None
-        # vids are GLOBALLY unique (node id in the high bits): they key the
-        # cross-replica payload arena, so two nodes must never mint the
-        # same vid for different requests.
-        if self._next_counter > VID_COUNTER_MASK:
-            raise RuntimeError("vid counter space exhausted")
-        vid = (self.my_id << VID_NODE_SHIFT) | self._next_counter
-        self._next_counter += 1
-        if request_id is None:
-            request_id = vid  # namespaced-unique by construction
-        if stop:
-            vid |= STOP_BIT
-        self.arena[vid] = request_value
-        self.vid_meta[vid] = (entry, request_id)
-        if callback is not None:
-            self.outstanding.put(request_id, callback)
-        self.queues.setdefault(row, []).append(vid)
         return vid
 
     def propose_stop(self, name: str, request_value: str = "", **kw) -> Optional[int]:
@@ -342,6 +354,10 @@ class PaxosManager:
     # host channel ingress (payload replication + forwarded proposals)
     # ------------------------------------------------------------------
     def on_host_message(self, kind: str, body: Dict) -> None:
+        with self._state_lock:
+            self._on_host_message_locked(kind, body)
+
+    def _on_host_message_locked(self, kind: str, body: Dict) -> None:
         if kind == "payloads":
             for k, v in body["arena"].items():
                 self.arena.setdefault(int(k), v)
@@ -420,7 +436,26 @@ class PaxosManager:
         heard: np.ndarray,
         want_coord: Optional[np.ndarray] = None,
     ) -> Tuple[Blob, Dict]:
-        """One full cycle; returns (my fresh blob, host-channel delta)."""
+        """One full cycle; returns (my fresh blob, host-channel delta).
+
+        Holds the manager lock for the whole cycle: the transport-thread
+        entry points (propose / on_host_message / create / kill) mutate
+        the same queues, arena, and vid tables this reads and rewrites.
+        User callbacks collected during execution fire AFTER the lock is
+        released (a blocking callback must not wedge transport threads)."""
+        with self._state_lock:
+            result = self._tick_locked(gathered, heard, want_coord)
+            fired, self._fired_callbacks = self._fired_callbacks, []
+        for cb, rid, resp in fired:
+            cb(rid, resp)
+        return result
+
+    def _tick_locked(
+        self,
+        gathered: Blob,
+        heard: np.ndarray,
+        want_coord: Optional[np.ndarray] = None,
+    ) -> Tuple[Blob, Dict]:
         cfg = self.cfg
         G, W, K = cfg.n_groups, cfg.window, cfg.req_lanes
         req = self.build_requests()
@@ -429,12 +464,11 @@ class PaxosManager:
             else jnp.asarray(want_coord, bool)
         )
         t0 = time.perf_counter()
-        with self._state_lock:
-            new_state, out = _step_jit(
-                self.state, gathered, jnp.asarray(heard),
-                jnp.asarray(req), wc, jnp.int32(self.my_id), cfg=cfg,
-            )
-            self.state = new_state
+        new_state, out = _step_jit(
+            self.state, gathered, jnp.asarray(heard),
+            jnp.asarray(req), wc, jnp.int32(self.my_id), cfg=cfg,
+        )
+        self.state = new_state
         DelayProfiler.update_delay("engine_step", time.perf_counter() - t0)
 
         out_np = jax.tree.map(np.asarray, out)
@@ -479,8 +513,15 @@ class PaxosManager:
                 if vid in self.vid_meta:
                     meta_delta[vid] = self.vid_meta[vid]
 
-        # log-before-send: persist the accept delta before the blob leaves
+        # log-before-send: persist the promise + accept delta before the
+        # blob leaves (bare promises too — a ballot that rose with no
+        # accept must survive a crash, ADVICE r1 high / handlePrepare's
+        # LogMessagingTask rule)
         if self.logger is not None:
+            pg = np.nonzero(out_np.bal_new)[0]
+            if len(pg):
+                bal_np = np.asarray(self.state.bal)
+                self.logger.log_promises(pg.astype(np.int32), bal_np[pg])
             gs, lanes = np.nonzero(out_np.acc_new)
             if len(gs):
                 acc_slot = np.asarray(self.state.acc_slot)
@@ -573,7 +614,9 @@ class PaxosManager:
             if entry == self.my_id:
                 cb = self.outstanding.pop(request_id)
                 if cb is not None:
-                    cb(request_id, self.response_cache[request_id][1])
+                    self._fired_callbacks.append(
+                        (cb, request_id, self.response_cache[request_id][1])
+                    )
             self.retained[vid] = (g, slot)
             return True
         req = RequestPacket(
@@ -599,7 +642,7 @@ class PaxosManager:
         if entry == self.my_id:
             cb = self.outstanding.pop(request_id)
             if cb is not None:
-                cb(request_id, response)
+                self._fired_callbacks.append((cb, request_id, response))
         self.retained[vid] = (g, slot)  # keep for straggler pulls
         return True
 
@@ -642,6 +685,14 @@ class PaxosManager:
         cut = time.time() - 60.0
         for key in [k for k, (t, _) in self.response_cache.items() if t < cut]:
             del self.response_cache[key]
+
+    def drain_forward_out(self) -> List[Tuple[int, str, Dict]]:
+        """Atomically take the pending outbound host-channel messages.
+        An unlocked swap could lose a message appended by a transport
+        thread between the load and the store."""
+        with self._state_lock:
+            out, self.forward_out = self.forward_out, []
+        return out
 
     def blob(self) -> Blob:
         """Current publishable snapshot (what peers gather)."""

@@ -136,6 +136,12 @@ class StepOutputs(NamedTuple):
     acc_new: jnp.ndarray       # [G, W] lanes newly accepted this step — the
     #   journal's log-before-send delta (AbstractPaxosLogger.logAndMessage
     #   rule: these rows must be durable before the blob is published)
+    bal_new: jnp.ndarray       # [G] 1 where the promised ballot rose this
+    #   step — must also be durable before the blob is published, even when
+    #   no accept carries it (the reference logs promise-upgrading prepare
+    #   replies before sending, PaxosInstanceStateMachine.handlePrepare);
+    #   otherwise a crashed acceptor forgets a bare promise and can accept
+    #   an older-ballot proposal it had promised against
     preempted_vid: jnp.ndarray  # [G, W] my proposals that lost their slot to
     #   another value (host re-proposes them; NULL elsewhere)
 
@@ -479,6 +485,7 @@ def step(
         maj_exec=jnp.where(m1, maj_exec, 0),
         app_hash=new_state.app_hash,
         acc_new=(m2 & acc_changed).astype(jnp.int32),
+        bal_new=(new_state.bal != state.bal).astype(jnp.int32),
         preempted_vid=jnp.where(m2, preempted_vid, NULL),
     )
     return new_state, outputs

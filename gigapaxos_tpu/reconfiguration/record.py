@@ -1,0 +1,100 @@
+"""Reconfiguration records: the per-name epoch state machine.
+
+API-parity target: ``reconfigurationutils/ReconfigurationRecord.java``
+(``RCStates`` enum at :53-91 and the epoch/actives/newActives fields).
+A record is plain JSON-serializable data — it IS the app state of the
+reconfigurators' own RSM (``rc_app.RCRepliconfigurableApp``), so every
+mutation happens deterministically inside ``Replicable.execute`` on all
+reconfigurators.
+
+State machine (``RCStates`` / ``setState`` transitions)::
+
+    READY --(INTENT: epoch e -> e+1, newActives)--> WAIT_ACK_STOP
+    WAIT_ACK_STOP --(old epoch stopped, final state fetched)--> WAIT_ACK_START
+    WAIT_ACK_START --(COMPLETE: majority of new actives ack)--> READY  (epoch e+1)
+    READY --(DELETE_INTENT)--> WAIT_DELETE --(drop acks / age-out)--> (purged)
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+class RCState(str, enum.Enum):
+    READY = "READY"
+    WAIT_ACK_STOP = "WAIT_ACK_STOP"
+    WAIT_ACK_START = "WAIT_ACK_START"
+    WAIT_DELETE = "WAIT_DELETE"
+
+
+@dataclass
+class ReconfigurationRecord:
+    name: str
+    epoch: int = 0
+    state: RCState = RCState.READY
+    actives: List[int] = field(default_factory=list)      # current epoch's replica set
+    new_actives: List[int] = field(default_factory=list)  # target set during a change
+    row: int = -1        # engine row of the current epoch's group (creator-chosen)
+    new_row: int = -1    # engine row for the pending epoch
+    deleted: bool = False
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "name": self.name, "epoch": self.epoch, "state": self.state.value,
+            "actives": self.actives, "new_actives": self.new_actives,
+            "row": self.row, "new_row": self.new_row, "deleted": self.deleted,
+        }
+
+    @classmethod
+    def from_json(cls, d: Dict[str, Any]) -> "ReconfigurationRecord":
+        return cls(
+            name=d["name"], epoch=int(d["epoch"]), state=RCState(d["state"]),
+            actives=list(d["actives"]), new_actives=list(d["new_actives"]),
+            row=int(d.get("row", -1)), new_row=int(d.get("new_row", -1)),
+            deleted=bool(d.get("deleted", False)),
+        )
+
+    # ---- transitions (setState analog, ReconfigurationRecord.java:466+) --
+    def start_reconfigure(self, new_actives: List[int], new_row: int) -> bool:
+        """INTENT: begin epoch e -> e+1 (READY -> WAIT_ACK_STOP)."""
+        if self.state is not RCState.READY or self.deleted:
+            return False
+        self.new_actives = list(new_actives)
+        self.new_row = int(new_row)
+        self.state = RCState.WAIT_ACK_STOP
+        return True
+
+    def stop_done(self) -> bool:
+        """Old epoch stopped & final state in hand (-> WAIT_ACK_START)."""
+        if self.state is not RCState.WAIT_ACK_STOP:
+            return False
+        self.state = RCState.WAIT_ACK_START
+        return True
+
+    def complete(self) -> bool:
+        """COMPLETE: majority of new actives running epoch e+1 (-> READY)."""
+        if self.state is not RCState.WAIT_ACK_START:
+            return False
+        self.epoch += 1
+        self.actives = list(self.new_actives)
+        self.row = self.new_row
+        self.new_actives = []
+        self.new_row = -1
+        self.state = RCState.READY
+        return True
+
+    def start_delete(self) -> bool:
+        """DELETE intent: READY -> WAIT_DELETE (two-phase delete,
+        Reconfigurator.java:747)."""
+        if self.state is not RCState.READY or self.deleted:
+            return False
+        self.state = RCState.WAIT_DELETE
+        return True
+
+    def finish_delete(self) -> bool:
+        if self.state is not RCState.WAIT_DELETE:
+            return False
+        self.deleted = True
+        return True

@@ -188,7 +188,7 @@ class PaxosServer:
             frame = encode_json("payloads", self.my_id, delta)
             for r in peers:
                 self.transport.send_to_id(r, frame)
-        fwd, self.manager.forward_out = self.manager.forward_out, []
+        fwd = self.manager.drain_forward_out()
         for dst, k, body in fwd:
             frame = encode_json(k, self.my_id, body)
             if dst == -1:

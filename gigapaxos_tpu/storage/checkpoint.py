@@ -5,10 +5,15 @@ The reference checkpoints *per group* into SQL tables (``checkpoint`` /
 is an object; here the whole engine is a handful of [G]/[G, W] arrays, so
 a checkpoint is a single bulk snapshot and recovery a single bulk load
 (the SURVEY §7 hard-part (d) answer).  App-level checkpoint strings
-(``Replicable.checkpoint``) ride in the sidecar.  The previous snapshot
-is kept (prev_checkpoint analog) and a torn write is detected via the
-atomic rename of the sidecar — the sidecar is written LAST, so a
-snapshot without a valid sidecar is ignored.
+(``Replicable.checkpoint``) ride in the sidecar.
+
+Torn-write protection: every snapshot embeds a **generation id** in both
+the .npz (``__generation__`` array) and the sidecar (``"generation"``
+key).  Both files of the new pair are fully written and fsynced to temp
+names *before* any rename; the loader accepts any (snapshot, sidecar)
+combination whose generation ids match, picking the highest generation —
+so a crash between any two renames still leaves at least one matched
+pair (the previous generation) discoverable.
 """
 
 from __future__ import annotations
@@ -24,49 +29,142 @@ META = "checkpoint.meta.json"
 PREV_SNAP = "prev_checkpoint.npz"
 PREV_META = "prev_checkpoint.meta.json"
 
+GEN_KEY = "__generation__"
+
+
+_LEGACY = -1  # marker for pre-generation files (no embedded id)
+
+
+def _snap_generation(path: str) -> Optional[int]:
+    """Generation embedded in a snapshot; _LEGACY if absent; None if unreadable."""
+    try:
+        with np.load(path) as z:
+            if GEN_KEY in z.files:
+                return int(z[GEN_KEY])
+            return _LEGACY
+    except Exception:
+        return None
+
+
+def _meta_generation(path: str) -> Optional[Tuple[int, Dict[str, Any]]]:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            meta = json.load(f)
+        return int(meta.get("generation", _LEGACY)), meta
+    except Exception:
+        return None
+
 
 def save_checkpoint(
     directory: str,
     arrays: Dict[str, np.ndarray],
     meta: Dict[str, Any],
 ) -> None:
-    """Atomically persist (arrays, meta), demoting the current pair to prev."""
+    """Atomically persist (arrays, meta), demoting the current pair to prev.
+
+    Write order (each file fsynced before any rename):
+      1. new snapshot  -> checkpoint.npz.tmp
+      2. new sidecar   -> checkpoint.meta.json.tmp
+      3. demote current pair to prev_*
+      4. promote the tmp pair to checkpoint.*
+    A crash at any point leaves >= 1 generation-matched pair on disk.
+    """
     os.makedirs(directory, exist_ok=True)
     snap = os.path.join(directory, SNAP)
     metaf = os.path.join(directory, META)
-    # demote current -> prev (both files, meta last so prev stays valid)
-    if os.path.exists(snap) and os.path.exists(metaf):
-        os.replace(snap, os.path.join(directory, PREV_SNAP))
-        os.replace(metaf, os.path.join(directory, PREV_META))
+
+    # next generation = 1 + highest generation visible on disk
+    gen = 0
+    for name in (SNAP, PREV_SNAP):
+        g = _snap_generation(os.path.join(directory, name))
+        if g is not None:
+            gen = max(gen, g)
+    for name in (META, PREV_META):
+        m = _meta_generation(os.path.join(directory, name))
+        if m is not None:
+            gen = max(gen, m[0])
+    gen += 1  # _LEGACY is -1, so legacy-only dirs start at generation 0+1
+
+    meta = dict(meta)
+    meta["generation"] = gen
+    payload = dict(arrays)
+    payload[GEN_KEY] = np.int64(gen)
+
     tmp = snap + ".tmp"
     with open(tmp, "wb") as f:
-        np.savez(f, **arrays)
+        np.savez(f, **payload)
         f.flush()
         os.fsync(f.fileno())
-    os.replace(tmp, snap)
     tmpm = metaf + ".tmp"
     with open(tmpm, "w", encoding="utf-8") as f:
         json.dump(meta, f)
         f.flush()
         os.fsync(f.fileno())
+
+    # Demote current -> prev ONLY as a generation-matched pair: a crash in
+    # a previous save can leave an orphan current file (snapshot without
+    # its sidecar or vice versa); demoting an orphan would overwrite half
+    # of a still-valid prev pair and can strand the directory with zero
+    # loadable checkpoints.  Orphans are deleted instead (they were never
+    # loadable on their own).
+    sg = _snap_generation(snap) if os.path.exists(snap) else None
+    m = _meta_generation(metaf) if os.path.exists(metaf) else None
+    mg = m[0] if m is not None else None
+    if sg is not None and sg == mg:
+        os.replace(snap, os.path.join(directory, PREV_SNAP))
+        os.replace(metaf, os.path.join(directory, PREV_META))
+    else:
+        if os.path.exists(snap):
+            os.remove(snap)
+        if os.path.exists(metaf):
+            os.remove(metaf)
+    os.replace(tmp, snap)
     os.replace(tmpm, metaf)
 
 
 def load_checkpoint(
     directory: str,
 ) -> Optional[Tuple[Dict[str, np.ndarray], Dict[str, Any]]]:
-    """Load the newest valid (arrays, meta) pair; falls back to prev."""
-    for snap_name, meta_name in ((SNAP, META), (PREV_SNAP, PREV_META)):
-        snap = os.path.join(directory, snap_name)
-        metaf = os.path.join(directory, meta_name)
-        if not (os.path.exists(snap) and os.path.exists(metaf)):
-            continue
+    """Load the newest valid generation-matched (arrays, meta) pair.
+
+    Tries every (snapshot, sidecar) combination so that a crash between
+    the demote/promote renames of :func:`save_checkpoint` (which can pair
+    e.g. ``prev_checkpoint.npz`` with ``checkpoint.meta.json``) still
+    finds the surviving pair; a sidecar is never silently combined with
+    a snapshot from a different generation.
+    """
+    snaps = {}   # name -> (gen, path)
+    for name in (SNAP, PREV_SNAP):
+        path = os.path.join(directory, name)
+        if os.path.exists(path):
+            g = _snap_generation(path)
+            if g is not None:
+                snaps[name] = (g, path)
+    metas = {}   # name -> (gen, meta)
+    for name in (META, PREV_META):
+        path = os.path.join(directory, name)
+        if os.path.exists(path):
+            m = _meta_generation(path)
+            if m is not None:
+                metas[name] = m
+
+    # Candidates: any cross combination whose EXPLICIT generations match;
+    # legacy files (no embedded id) only pair name-aligned — current with
+    # current, prev with prev — since 'both lack an id' proves nothing
+    # about belonging together across names.
+    candidates = []  # (gen, snap_path, meta)
+    for sname, (sg, spath) in snaps.items():
+        for mname, (mg, meta) in metas.items():
+            aligned = (sname, mname) in ((SNAP, META), (PREV_SNAP, PREV_META))
+            if sg == mg != _LEGACY or (sg == mg == _LEGACY and aligned):
+                candidates.append((sg, sname == SNAP, spath, meta))
+    # highest generation first; at equal gen prefer the current-named pair
+    candidates.sort(key=lambda c: (c[0], c[1]), reverse=True)
+    for _gen, _cur, spath, meta in candidates:
         try:
-            with open(metaf, "r", encoding="utf-8") as f:
-                meta = json.load(f)
-            with np.load(snap) as z:
-                arrays = {k: z[k] for k in z.files}
+            with np.load(spath) as z:
+                arrays = {k: z[k] for k in z.files if k != GEN_KEY}
             return arrays, meta
         except Exception:
-            continue  # torn/corrupt: try prev
+            continue  # corrupt body despite readable header: try next pair
     return None

@@ -43,6 +43,9 @@ class BlockType(enum.IntEnum):
     #                 name->row map + initial app state of CREATE blocks;
     #                 names are host-side strings so they can't ride the
     #                 packed int32 CREATE columns)
+    PROMISES = 9    # cols: group, ballot — a bare promise (ballot rose with
+    #                 no accompanying accept); ref: handlePrepare's
+    #                 log-before-send of promise-upgrading prepare replies
 
 
 def _file_name(idx: int) -> str:

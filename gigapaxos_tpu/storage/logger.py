@@ -65,6 +65,13 @@ class PaxosLogger:
         if len(groups):
             self.journal.append_columns(BlockType.DECISIONS, [groups, slots, vids])
 
+    def log_promises(self, groups, bals) -> None:
+        """Bare promise upgrades (ballot rose without an accept) — must be
+        durable before the blob is published, or a restarted acceptor could
+        accept an older-ballot proposal it had promised against."""
+        if len(groups):
+            self.journal.append_columns(BlockType.PROMISES, [groups, bals])
+
     def log_create(
         self, groups, masks, versions, coords, names=None, inits=None
     ) -> None:
@@ -197,10 +204,19 @@ class PaxosLogger:
             m = Journal.columns(payload, n_rows, 4)
             g, slot, bal, vid = m.T
             lane = slot % W
+            # One engine step accepts each (group, lane) at most once, so a
+            # block never carries duplicate (g, lane) pairs and plain fancy
+            # indexing is safe for the window scatter; the ballot fold uses
+            # maximum.at so duplicate groups within a block (several lanes
+            # of one group) still take a running max, not last-write-wins.
             arrays["acc_bal"][g, lane] = bal
             arrays["acc_vid"][g, lane] = vid
             arrays["acc_slot"][g, lane] = slot
-            arrays["bal"][g] = np.maximum(arrays["bal"][g], bal)
+            np.maximum.at(arrays["bal"], g, bal)
+        elif btype == BlockType.PROMISES:
+            m = Journal.columns(payload, n_rows, 2)
+            g, bal = m.T
+            np.maximum.at(arrays["bal"], g, bal)
         elif btype == BlockType.DECISIONS:
             m = Journal.columns(payload, n_rows, 3)
             g, slot, vid = m.T

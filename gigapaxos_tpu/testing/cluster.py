@@ -102,7 +102,7 @@ class ManagerCluster:
                     if j != i and delivery[j, i] == DELIVER:
                         self.inboxes[j].append(("payloads", delta))
             mgr = self.managers[i]
-            fwd, mgr.forward_out = mgr.forward_out, []
+            fwd = mgr.drain_forward_out()
             for dst, kind, body in fwd:
                 if dst == i:
                     mgr.on_host_message(kind, body)

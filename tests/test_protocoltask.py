@@ -1,0 +1,109 @@
+"""Protocol-task runtime tests (ProtocolExecutor.java / ThresholdProtocolTask
+analog): keyed routing, idempotent spawn, threshold acks with laggard-only
+retransmit, restarts, expiry."""
+
+from gigapaxos_tpu.protocoltask import (
+    ProtocolExecutor,
+    ProtocolTask,
+    ThresholdProtocolTask,
+)
+
+
+class PingTask(ProtocolTask):
+    restart_period_s = 1.0
+    max_lifetime_s = 10.0
+
+    def __init__(self, key, dsts):
+        super().__init__(key)
+        self.dsts = dsts
+        self.expired = False
+
+    def start(self):
+        return [(d, "ping", {"key": self.key}) for d in self.dsts]
+
+    def handle_event(self, kind, body):
+        if kind == "pong":
+            self.done = True
+        return ()
+
+    def on_expire(self):
+        self.expired = True
+
+
+class MajorityAck(ThresholdProtocolTask):
+    restart_period_s = 1.0
+
+    def __init__(self, key, nodes):
+        super().__init__(key, nodes)
+        self.fired = []
+
+    def send_to(self, node):
+        return (node, "req", {"key": self.key})
+
+    def is_ack(self, kind, body):
+        return body.get("from") if kind == "ack" else None
+
+    def on_threshold(self):
+        self.fired.append(tuple(sorted(self.acked)))
+        return [("done-dst", "complete", {"key": self.key})]
+
+
+def test_spawn_routes_and_completes():
+    ex = ProtocolExecutor()
+    t = PingTask("k1", [1, 2])
+    assert ex.spawn(t, now=0.0)
+    assert [m[1] for m in ex.outbox] == ["ping", "ping"]
+    assert ex.is_running("k1")
+    # unknown key: not consumed
+    assert not ex.handle_event("zzz", "pong", {})
+    assert ex.handle_event("k1", "pong", {})
+    assert not ex.is_running("k1")  # done -> reaped
+
+
+def test_spawn_if_not_running_idempotent():
+    ex = ProtocolExecutor()
+    assert ex.spawn_if_not_running("k", lambda: PingTask("k", [1]), now=0.0)
+    assert not ex.spawn_if_not_running("k", lambda: PingTask("k", [1]), now=0.0)
+    assert len(ex) == 1
+
+
+def test_threshold_laggard_retransmit():
+    ex = ProtocolExecutor()
+    t = MajorityAck("m", [10, 11, 12])
+    ex.spawn(t, now=0.0)
+    assert len(ex.outbox) == 3  # initial sends to all
+    ex.outbox.clear()
+    ex.handle_event("m", "ack", {"from": 10})
+    # restart retransmits ONLY to laggards 11, 12
+    ex.tick(now=1.5)
+    assert sorted(m[0] for m in ex.outbox) == [11, 12]
+    ex.outbox.clear()
+    # non-member ack ignored
+    ex.handle_event("m", "ack", {"from": 99})
+    assert not t.done
+    ex.handle_event("m", "ack", {"from": 12})
+    # majority (2/3) -> on_threshold fired once, task reaped
+    assert t.fired == [(10, 12)]
+    assert ex.outbox == [("done-dst", "complete", {"key": "m"})]
+    assert not ex.is_running("m")
+
+
+def test_restart_period_and_expiry():
+    ex = ProtocolExecutor()
+    t = PingTask("p", [7])
+    ex.spawn(t, now=0.0)
+    ex.outbox.clear()
+    ex.tick(now=0.5)          # before period: nothing
+    assert ex.outbox == []
+    ex.tick(now=1.1)          # past period: retransmit
+    assert len(ex.outbox) == 1
+    ex.tick(now=11.0)         # past lifetime: expired + dropped
+    assert t.expired and not ex.is_running("p")
+
+
+def test_send_fn_direct_delivery():
+    sent = []
+    ex = ProtocolExecutor(send=sent.append)
+    ex.spawn(PingTask("k", [3]), now=0.0)
+    assert sent == [(3, "ping", {"key": "k"})]
+    assert ex.outbox == []
