@@ -112,8 +112,15 @@ class PaxosManager:
         self.checkpoint_every = checkpoint_every
 
         # host-side tables
-        self.names: Dict[str, int] = {}        # service name -> group row
-        self.row_name: Dict[int, str] = {}     # occupancy: row -> name
+        self.names: Dict[str, int] = {}        # service name -> CURRENT epoch row
+        self.row_name: Dict[int, str] = {}     # occupancy: row -> name (or name@vE)
+        # stopped prior epochs kept until the reconfigurator drops them
+        # (epoch final state may still be fetched from their app snapshot)
+        self.old_epochs: Dict[Tuple[str, int], int] = {}  # (name, epoch) -> row
+        # fired on EVERY replica when an epoch-final stop request executes
+        # (the reconfiguration layer captures the final state here);
+        # signature: (name, row, epoch)
+        self.on_stop_executed: Optional[Callable[[str, int, int], None]] = None
         self.arena: Dict[int, str] = {}        # vid -> request payload (json str)
         self.vid_meta: Dict[int, Tuple[int, int]] = {}  # vid -> (entry_replica, request_id)
         self.outstanding = Outstanding()
@@ -172,11 +179,38 @@ class PaxosManager:
             self.vid_meta.setdefault(int(k), (v[0], v[1]))
         self.arena.update(rec.payloads)  # journal blocks are newer
         self.names = {str(k): int(v) for k, v in meta.get("names", {}).items()}
+        self.old_epochs = {
+            (str(n), int(e)): int(r)
+            for n, e, r in meta.get("old_epochs", [])
+        }
+        versions = np.asarray(self.state.version)
+        masks = np.asarray(self.state.member_mask)
         journal_inits: Dict[str, Optional[str]] = {}
-        for nm, ent in rec.names.items():  # creates after the checkpoint
-            self.names[nm] = int(ent["row"])
-            journal_inits[nm] = ent.get("init")
+        for nm, ents in rec.names.items():  # creates after the checkpoint
+            # entries replay in journal order; a later entry for the same
+            # name is an epoch upgrade — the prior epoch's row is demoted
+            # to old_epochs exactly as the live create path does
+            for ent in ents:
+                prev_row = self.names.get(nm)
+                if prev_row is not None and prev_row != int(ent["row"]):
+                    self.old_epochs[(nm, int(versions[prev_row]))] = prev_row
+                self.names[nm] = int(ent["row"])
+            journal_inits[nm] = ents[-1].get("init")
+        # Interleaved KILL blocks (epoch drops / deletes) zeroed the killed
+        # rows' member_mask in the arrays but the replay above can't see
+        # them — filter mappings whose row was killed, and old-epoch claims
+        # on rows that another (newer) name now occupies.
+        self.names = {
+            n: r for n, r in self.names.items() if int(masks[r]) != 0
+        }
+        live_rows = set(self.names.values())
+        self.old_epochs = {
+            (n, e): r for (n, e), r in self.old_epochs.items()
+            if int(masks[r]) != 0 and r not in live_rows
+        }
         self.row_name = {v: k for k, v in self.names.items()}
+        for (nm, e), r in self.old_epochs.items():
+            self.row_name[r] = nm
         self._next_counter = int(meta.get("next_counter", 1))
         for vid in rec.payloads:
             base = vid & ~STOP_BIT
@@ -195,6 +229,13 @@ class PaxosManager:
             self.pending_exec[int(g_str)] = {
                 int(s_): int(v) for s_, v in pend.items()
             }
+        # stopped prior epochs never execute further on the host: the new
+        # epoch's restore subsumed their trailing slots, and re-executing
+        # them here would double-apply onto the restored app state
+        exec_np = np.asarray(self.state.exec_slot)
+        for (_nm, _e), r in self.old_epochs.items():
+            self.app_exec_slot[r] = int(exec_np[r])
+            self.pending_exec.pop(r, None)
         app_states = meta.get("app_states") or {}
         for name, state_str in app_states.items():
             if name in self.names:
@@ -241,7 +282,37 @@ class PaxosManager:
 
     def _create_locked(self, name, members, initial_state, version, row) -> bool:
         if name in self.names:
-            return False
+            cur_row = self.names[name]
+            cur_ver = int(np.asarray(self.state.version)[cur_row])
+            if version < cur_ver:
+                return False
+            if version == cur_ver:
+                if row is None or int(row) == cur_row:
+                    return True  # idempotent re-create (start-epoch retransmit)
+                # Same-epoch row change: the reconfigurator's row probe moved
+                # to a fresh row after a collision NACK from some member.
+                # Safe pre-COMPLETE: clients can't know the group yet, so the
+                # short-lived first row has executed nothing; recreate.
+                self._kill_locked(name)
+            else:
+                # Epoch upgrade (reconfiguration): the stopped prior epoch's
+                # row stays resident under (name, old_epoch) until the
+                # reconfigurator drops it; the name re-maps to the new row
+                # (PaxosManager's paxosID+version instance keying analog).
+                if not int(np.asarray(self.state.stopped)[cur_row]):
+                    return False  # old epoch must stop before the next starts
+                self.old_epochs[(name, cur_ver)] = cur_row
+                # row_name keeps the REAL name (occupancy only needs the key);
+                # trailing executions of the old row must see the true
+                # paxos_id, not a mangled alias
+                del self.names[name]
+                # The new epoch's initial state (the stop-time final state)
+                # subsumes any of the old row's decided-but-unexecuted slots;
+                # executing them after the restore would double-apply them.
+                self.pending_exec.pop(cur_row, None)
+                self.app_exec_slot[cur_row] = int(
+                    np.asarray(self.state.exec_slot)[cur_row]
+                )
         row = self.default_row_for(name) if row is None else int(row)
         if row in self.row_name:
             raise RuntimeError(
@@ -287,12 +358,62 @@ class PaxosManager:
         self.pending_exec.pop(row, None)
         return True
 
+    def kill_epoch(self, name: str, epoch: int) -> bool:
+        """Free a stopped prior epoch's row (DropEpochFinalState analog:
+        the reconfigurator garbage-collects the old epoch once the new one
+        is running)."""
+        with self._state_lock:
+            row = self.old_epochs.pop((name, epoch), None)
+            if row is None:
+                # dropping the current epoch is only legal if it's stopped
+                # and matches (delete-service path)
+                cur = self.names.get(name)
+                if cur is None:
+                    return False
+                if int(np.asarray(self.state.version)[cur]) != epoch:
+                    return False
+                if not int(np.asarray(self.state.stopped)[cur]):
+                    return False  # never kill a live, unstopped group
+                return self._kill_locked(name)
+            del self.row_name[row]
+            self.state = kill_groups(self.state, np.array([row]))
+            if self.logger:
+                self.logger.log_kill(np.array([row]))
+            self.queues.pop(row, None)
+            self.pending_exec.pop(row, None)
+            return True
+
     def get_replica_group(self, name: str) -> Optional[List[int]]:
         row = self.names.get(name)
         if row is None:
             return None
         mask = int(np.asarray(self.state.member_mask)[row])
         return [r for r in range(32) if (mask >> r) & 1]
+
+    def epoch_row(self, name: str, epoch: int) -> Optional[int]:
+        """Row hosting (name, epoch) here — current or demoted — or None."""
+        with self._state_lock:
+            row = self.old_epochs.get((name, epoch))
+            if row is not None:
+                return row
+            cur = self.names.get(name)
+            if cur is not None and int(np.asarray(self.state.version)[cur]) == epoch:
+                return cur
+            return None
+
+    def current_epoch(self, name: str) -> Optional[int]:
+        with self._state_lock:
+            row = self.names.get(name)
+            if row is None:
+                return None
+            return int(np.asarray(self.state.version)[row])
+
+    def is_stopped(self, name: str) -> bool:
+        with self._state_lock:
+            row = self.names.get(name)
+            if row is None:
+                return False
+            return bool(int(np.asarray(self.state.stopped)[row]))
 
     # ------------------------------------------------------------------
     # propose (PaxosManager.propose/proposeStop, :1195-1390)
@@ -637,6 +758,12 @@ class PaxosManager:
             raise RuntimeError(f"app refused to execute {name}:{slot}")
         self.total_executed += 1
         self._slots_since_ckpt += 1
+        if (vid & STOP_BIT) and self.on_stop_executed is not None and name:
+            epoch = int(np.asarray(self.state.version)[g])
+            try:
+                self.on_stop_executed(name, g, epoch)
+            except Exception:
+                pass  # reconfiguration-layer hook must not wedge execution
         response = getattr(req, "response_value", None)
         self.response_cache[request_id] = (time.time(), response)
         if entry == self.my_id:
@@ -671,6 +798,7 @@ class PaxosManager:
         # execution exactly where the app state string left off.
         self.logger.checkpoint(arrays, app_states, {
             "names": self.names,
+            "old_epochs": [[n, e, r] for (n, e), r in self.old_epochs.items()],
             "next_counter": self._next_counter,
             "arena": self.arena,
             "vid_meta": {k: list(v) for k, v in self.vid_meta.items()},

@@ -1,0 +1,246 @@
+"""ActiveReplica: executes epoch operations against the app's coordinator.
+
+API-parity target: ``ActiveReplica`` (``ActiveReplica.java:128``) —
+demultiplexes reconfiguration packets vs app requests and executes epoch
+ops: ``handleStartEpoch``:796 (create the new epoch's group, fetching the
+previous epoch's final state if any), ``handleStopEpoch``:917 (coordinate
+an epoch-final stop through the group), ``handleDropEpochFinalState``:968
+(GC the old epoch), ``handleRequestEpochFinalState``:1051 (serve a stored
+final state to a new-epoch replica).
+
+Messaging is transport-agnostic: a ``send(dst, kind, body)`` callable is
+injected (dst = ("AR"|"RC", id)); the epoch-final-state fetch runs as a
+:class:`WaitEpochFinalState` protocol task (``WaitEpochFinalState.java``
+analog), retransmitting round-robin over the previous epoch's actives.
+"""
+
+from __future__ import annotations
+
+import json
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..protocoltask import ProtocolExecutor, ProtocolTask
+from .coordinator import AbstractReplicaCoordinator
+
+Addr = Tuple[str, int]  # ("AR"|"RC", node id)
+
+
+def stop_request_id(name: str, epoch: int) -> int:
+    """Deterministic id for the epoch-final stop request: every active may
+    propose it, the response cache dedupes execution to exactly once."""
+    return zlib.crc32(f"__stop__:{name}:{epoch}".encode()) | (1 << 40)
+
+
+class WaitEpochFinalState(ProtocolTask):
+    """Fetch the previous epoch's final state from its actives, then create
+    the new epoch's group (``WaitEpochFinalState.java`` analog)."""
+
+    restart_period_s = 1.0
+    max_lifetime_s = 30.0
+
+    def __init__(self, key: str, ar: "ActiveReplica", body: Dict):
+        super().__init__(key)
+        self.ar = ar
+        self.body = body  # the start_epoch body this fetch serves
+        self._rr = 0      # round-robin cursor over prev actives
+
+    def start(self):
+        prev = [a for a in self.body["prev_actives"]]
+        if not prev:
+            self.done = True
+            return ()
+        dst = prev[self._rr % len(prev)]
+        self._rr += 1
+        return [(("AR", dst), "request_epoch_final_state", {
+            "name": self.body["name"],
+            "epoch": self.body["prev_epoch"],
+            "from": self.ar.my_id,
+        })]
+
+    def handle_event(self, kind: str, body: Dict):
+        if kind != "epoch_final_state":
+            return ()
+        self.done = True
+        return self.ar._finish_start_epoch(self.body, body.get("state"))
+
+
+class ActiveReplica:
+    def __init__(
+        self,
+        my_id: int,
+        coordinator: AbstractReplicaCoordinator,
+        send: Callable[[Addr, str, Dict], None],
+    ):
+        self.my_id = int(my_id)
+        self.coordinator = coordinator
+        self.send = send
+        self.tasks = ProtocolExecutor(
+            send=lambda m: self.send(m[0], m[1], m[2])
+        )
+        # (name, epoch) -> final app state captured when the stop executed
+        # (LargeCheckpointer / getEpochFinalCheckpointState analog)
+        self.final_states: Dict[Tuple[str, int], Optional[str]] = {}
+        # stop acks owed once the local stop executes: (name, epoch) -> [rc]
+        self._pending_stop_acks: Dict[Tuple[str, int], List[Addr]] = {}
+        # highest row-probe attempt seen per (name, epoch): a delayed
+        # duplicate of an EARLIER probe must never recreate the group at a
+        # stale row after a later probe won
+        self._create_attempts: Dict[Tuple[str, int], int] = {}
+        # hook the manager's stop-execution signal
+        mgr = getattr(coordinator, "manager", None)
+        if mgr is not None:
+            mgr.on_stop_executed = self._on_stop_executed
+
+    # ------------------------------------------------------------------
+    # epoch-op handlers (dispatch table)
+    # ------------------------------------------------------------------
+    def handle_message(self, kind: str, body: Dict, frm: Optional[Addr] = None) -> None:
+        if kind == "start_epoch":
+            self._handle_start_epoch(body)
+        elif kind == "stop_epoch":
+            self._handle_stop_epoch(body)
+        elif kind == "drop_epoch":
+            self._handle_drop_epoch(body)
+        elif kind == "request_epoch_final_state":
+            self._handle_request_final_state(body)
+        elif kind == "epoch_final_state":
+            self.tasks.handle_event(
+                f"wefs:{body['name']}:{body['epoch']}", kind, body
+            )
+
+    def tick(self, now: Optional[float] = None) -> None:
+        self.tasks.tick(now)
+
+    # ---- start (handleStartEpoch, ActiveReplica.java:796) --------------
+    def _handle_start_epoch(self, body: Dict) -> None:
+        name, epoch = body["name"], int(body["epoch"])
+        prev_actives = body.get("prev_actives") or []
+        if not prev_actives:
+            # fresh create: initial state rides in the packet
+            self._ack_start(body, self._create(body, body.get("initial_state")))
+            return
+        fs_key = (name, int(body["prev_epoch"]))
+        if fs_key in self.final_states:
+            # I was in the previous epoch and hold the final state locally
+            self._ack_start(
+                body, self._create(body, self.final_states[fs_key])
+            )
+            return
+        # fetch the previous epoch's final state from its actives; the task
+        # is keyed by the PREVIOUS epoch (what is being fetched)
+        key = f"wefs:{name}:{int(body['prev_epoch'])}"
+        self.tasks.spawn_if_not_running(
+            key, lambda: WaitEpochFinalState(key, self, body)
+        )
+
+    def _finish_start_epoch(self, body: Dict, state: Optional[str]):
+        self._ack_start(body, self._create(body, state))
+        return ()
+
+    def _create(self, body: Dict, state: Optional[str]) -> bool:
+        key = (body["name"], int(body["epoch"]))
+        attempt = int(body.get("attempt", 0))
+        if attempt < self._create_attempts.get(key, 0):
+            return False  # stale row probe (delayed duplicate): never act
+        self._create_attempts[key] = attempt
+        try:
+            return self.coordinator.create_replica_group(
+                body["name"], int(body["epoch"]), list(body["actives"]),
+                state, row=int(body["row"]),
+            )
+        except RuntimeError:
+            return False  # row collision -> NACK; the RC probes another row
+
+    def _ack_start(self, body: Dict, ok: bool) -> None:
+        self.send(tuple(body["rc"]), "ack_start_epoch", {
+            "name": body["name"], "epoch": body["epoch"],
+            "row": body["row"], "ok": ok, "from": self.my_id,
+        })
+
+    # ---- stop (handleStopEpoch, ActiveReplica.java:917) ----------------
+    def _handle_stop_epoch(self, body: Dict) -> None:
+        name, epoch = body["name"], int(body["epoch"])
+        rc = tuple(body["rc"])
+        if (name, epoch) in self.final_states:
+            self._ack_stop(rc, name, epoch)  # already stopped + captured
+            return
+        mgr = getattr(self.coordinator, "manager", None)
+        cur_epoch = mgr.current_epoch(name) if mgr is not None else None
+        if cur_epoch is None or cur_epoch > epoch:
+            # unknown here (I never created this epoch) or already moved
+            # past it: nothing to stop — ack so the task can make progress
+            # (a STALE duplicate must never stop the live e+1 group)
+            self._ack_stop(rc, name, epoch)
+            return
+        if cur_epoch < epoch:
+            return  # start_epoch for this epoch hasn't landed yet; retransmit finds us later
+        self._pending_stop_acks.setdefault((name, epoch), [])
+        if rc not in self._pending_stop_acks[(name, epoch)]:
+            self._pending_stop_acks[(name, epoch)].append(rc)
+        if mgr is not None and mgr.is_stopped(name):
+            # stop decided on-device (e.g. proposed by a peer) but the local
+            # app hasn't executed it yet — the on_stop_executed hook will
+            # fire the ack; don't re-propose
+            return
+        # propose the epoch-final stop through the group; deterministic
+        # request id makes concurrent proposals from every active collapse
+        # to one execution (exactly-once via the response cache)
+        self.coordinator.coordinate_request(
+            name, json.dumps({"__stop__": epoch}), stop=True,
+            request_id=stop_request_id(name, epoch),
+        )
+
+    def _on_stop_executed(self, name: str, row: int, epoch: int) -> None:
+        """Manager hook: fires on EVERY replica when the stop executes."""
+        self.final_states[(name, epoch)] = self.coordinator.app.checkpoint(name)
+        for rc in self._pending_stop_acks.pop((name, epoch), []):
+            self._ack_stop(rc, name, epoch)
+
+    def _ack_stop(self, rc: Addr, name: str, epoch: int) -> None:
+        self.send(rc, "ack_stop_epoch", {
+            "name": name, "epoch": epoch, "from": self.my_id,
+        })
+
+    # ---- final-state serving (handleRequestEpochFinalState, :1051) -----
+    def _handle_request_final_state(self, body: Dict) -> None:
+        name, epoch = body["name"], int(body["epoch"])
+        key = (name, epoch)
+        state = self.final_states.get(key)
+        if key not in self.final_states:
+            # Restart fallback: the in-memory capture was lost, but if this
+            # node still hosts (name, epoch) as its CURRENT mapping and the
+            # stop executed (app state == final state), serve a fresh
+            # checkpoint of it.  (Old-epoch rows on overlap members can't
+            # serve — their app state moved on — but the requester
+            # round-robins over all prev actives.)
+            mgr = getattr(self.coordinator, "manager", None)
+            if (
+                mgr is None or mgr.current_epoch(name) != epoch
+                or not mgr.is_stopped(name)
+            ):
+                return
+            state = self.coordinator.app.checkpoint(name)
+            self.final_states[key] = state
+        self.send(("AR", int(body["from"])), "epoch_final_state", {
+            "name": name,
+            "epoch": epoch,  # the PREV epoch being served
+            "state": state,
+        })
+
+    # ---- drop (handleDropEpochFinalState, :968) ------------------------
+    def _handle_drop_epoch(self, body: Dict) -> None:
+        name, epoch = body["name"], int(body["epoch"])
+        mgr = getattr(self.coordinator, "manager", None)
+        exists = mgr is not None and mgr.epoch_row(name, epoch) is not None
+        if exists:
+            if not self.coordinator.delete_replica_group(name, epoch):
+                # group present but not yet stopped locally (lagging stop
+                # execution): stay silent, the drop task's retransmit will
+                # find us once the stop lands — never kill a live group
+                return
+        self.final_states.pop((name, epoch), None)
+        self._create_attempts.pop((name, epoch), None)
+        self.send(tuple(body["rc"]), "ack_drop_epoch", {
+            "name": name, "epoch": epoch, "from": self.my_id,
+        })

@@ -1,0 +1,98 @@
+"""Replica-coordination SPI between the app and the reconfiguration layer.
+
+API-parity target: ``AbstractReplicaCoordinator`` (abstract
+``coordinateRequest`` / ``createReplicaGroup`` / ``deleteReplicaGroup`` /
+``getReplicaGroup``, ``AbstractReplicaCoordinator.java:100-117``) and its
+only production subclass ``PaxosReplicaCoordinator``
+(``PaxosReplicaCoordinator.java:47`` — maps service names to paxos groups,
+``coordinateRequest`` -> ``PaxosManager.propose[Stop]``).
+
+The TPU re-design keeps the same seam: :class:`ActiveReplica` talks only
+to this interface, so alternative coordination protocols (chain
+replication, primary-backup) could slot in without touching the epoch
+machinery — exactly the reference's intent.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from ..interfaces.app import Replicable
+from ..manager import PaxosManager
+
+
+class AbstractReplicaCoordinator:
+    """Coordination SPI (``AbstractReplicaCoordinator.java:78``)."""
+
+    def __init__(self, app: Replicable):
+        self.app = app
+
+    # -- request plane ---------------------------------------------------
+    def coordinate_request(
+        self,
+        name: str,
+        value: str,
+        callback: Optional[Callable] = None,
+        stop: bool = False,
+        request_id: Optional[int] = None,
+    ) -> bool:
+        raise NotImplementedError
+
+    # -- epoch plane -----------------------------------------------------
+    def create_replica_group(
+        self,
+        name: str,
+        epoch: int,
+        members: List[int],
+        initial_state: Optional[str],
+        row: Optional[int] = None,
+    ) -> bool:
+        raise NotImplementedError
+
+    def delete_replica_group(self, name: str, epoch: int) -> bool:
+        raise NotImplementedError
+
+    def get_replica_group(self, name: str) -> Optional[List[int]]:
+        raise NotImplementedError
+
+
+class PaxosReplicaCoordinator(AbstractReplicaCoordinator):
+    """Names -> engine rows via a :class:`PaxosManager`."""
+
+    def __init__(self, app: Replicable, manager: PaxosManager):
+        super().__init__(app)
+        self.manager = manager
+
+    def coordinate_request(
+        self,
+        name: str,
+        value: str,
+        callback: Optional[Callable] = None,
+        stop: bool = False,
+        request_id: Optional[int] = None,
+    ) -> bool:
+        vid = self.manager.propose(
+            name, value, callback=callback, stop=stop, request_id=request_id
+        )
+        # None means either unknown name (failure) or an exactly-once
+        # cache hit (already answered through the callback) — both are
+        # "nothing new was coordinated"
+        return vid is not None
+
+    def create_replica_group(
+        self,
+        name: str,
+        epoch: int,
+        members: List[int],
+        initial_state: Optional[str],
+        row: Optional[int] = None,
+    ) -> bool:
+        return self.manager.create_paxos_instance(
+            name, members, initial_state=initial_state, version=epoch, row=row
+        )
+
+    def delete_replica_group(self, name: str, epoch: int) -> bool:
+        return self.manager.kill_epoch(name, epoch)
+
+    def get_replica_group(self, name: str) -> Optional[List[int]]:
+        return self.manager.get_replica_group(name)

@@ -1,0 +1,106 @@
+"""The reconfigurators' own RSM: reconfiguration records as a Replicable.
+
+API-parity target: ``AbstractReconfiguratorDB`` /
+``RepliconfigurableReconfiguratorDB`` (``AbstractReconfiguratorDB.java:84-96``,
+``RepliconfigurableReconfiguratorDB.java:54``) — RC records are themselves
+paxos-replicated among the reconfigurators, so every RC applies the same
+record transitions in the same order (the reference's recursion: the
+control plane rides the same consensus engine as the data plane).
+
+Requests are JSON ops (``RCRecordRequest`` INTENT/COMPLETE analog); the
+executing replica reports each applied op through ``on_applied`` so the
+local :class:`Reconfigurator` can advance its protocol tasks
+(``CommitWorker`` callback analog).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Callable, Dict, Optional
+
+from ..interfaces.app import Replicable, Request
+from ..packets.paxos_packets import RequestPacket
+from .record import RCState, ReconfigurationRecord
+
+# op kinds (RCRecordRequest.RequestTypes analog)
+CREATE_INTENT = "create_intent"      # new name: record born in WAIT_ACK_START
+RECONFIGURE_INTENT = "reconfigure_intent"  # epoch e -> e+1: -> WAIT_ACK_STOP
+STOP_DONE = "stop_done"              # old epoch stopped: -> WAIT_ACK_START
+COMPLETE = "complete"                # majority of new actives up: -> READY
+DELETE_INTENT = "delete_intent"      # -> WAIT_DELETE
+DELETE_FINAL = "delete_final"        # purge record
+
+
+class RCRecordsApp(Replicable):
+    """Replicable over the {name -> ReconfigurationRecord} map."""
+
+    def __init__(self, on_applied: Optional[Callable[[Dict], None]] = None):
+        self.records: Dict[str, ReconfigurationRecord] = {}
+        self.on_applied = on_applied
+
+    # ---- Replicable ----------------------------------------------------
+    def execute(self, request: Request, do_not_reply_to_client: bool = False) -> bool:
+        assert isinstance(request, RequestPacket)
+        op = json.loads(request.request_value)
+        applied = self._apply(op)
+        op["applied"] = applied
+        request.response_value = json.dumps({"ok": applied})
+        if self.on_applied is not None:
+            self.on_applied(op)
+        return True
+
+    def _apply(self, op: Dict) -> bool:
+        kind, name = op["op"], op["name"]
+        rec = self.records.get(name)
+        if kind == CREATE_INTENT:
+            if rec is not None and not rec.deleted:
+                return False
+            rec = ReconfigurationRecord(
+                name=name, epoch=int(op.get("epoch", 0)),
+                state=RCState.WAIT_ACK_START,
+                actives=[], new_actives=list(op["actives"]),
+                row=-1, new_row=int(op["row"]),
+            )
+            self.records[name] = rec
+            return True
+        if rec is None or rec.deleted:
+            return False
+        if kind == RECONFIGURE_INTENT:
+            return rec.start_reconfigure(list(op["new_actives"]), int(op["new_row"]))
+        if kind == STOP_DONE:
+            return rec.stop_done()
+        if kind == COMPLETE:
+            if rec.state is not RCState.WAIT_ACK_START:
+                return False  # duplicate/late COMPLETE: don't touch the record
+            # row retry: a start-epoch NACK (row collision) re-proposes with
+            # a probed row; the committed COMPLETE records the row that won
+            if "row" in op:
+                rec.new_row = int(op["row"])
+            return rec.complete()
+        if kind == DELETE_INTENT:
+            return rec.start_delete()
+        if kind == DELETE_FINAL:
+            if rec.finish_delete():
+                del self.records[name]
+                return True
+            return False
+        return False
+
+    def checkpoint(self, name: str) -> Optional[str]:
+        # the whole record map is ONE RSM (one paxos group among the RCs),
+        # so the checkpoint is the full map regardless of `name`
+        return json.dumps({n: r.to_json() for n, r in self.records.items()})
+
+    def restore(self, name: str, state: Optional[str]) -> bool:
+        self.records = {} if not state else {
+            n: ReconfigurationRecord.from_json(d)
+            for n, d in json.loads(state).items()
+        }
+        return True
+
+    # ---- reads (RequestActiveReplicas analog) --------------------------
+    def get_record(self, name: str) -> Optional[ReconfigurationRecord]:
+        return self.records.get(name)
+
+    def get_request(self, stringified: str) -> Request:
+        return RequestPacket.from_json(json.loads(stringified))

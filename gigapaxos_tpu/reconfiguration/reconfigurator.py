@@ -1,0 +1,421 @@
+"""Reconfigurator: the control-plane replica orchestrating epochs.
+
+API-parity target: ``Reconfigurator`` (``Reconfigurator.java:125``) —
+consistent-hashed ownership of names, create (``handleCreateServiceName``
+:484), delete (``handleDeleteServiceName``:747, two-phase), replica-set
+migration via the protocol-task chain ``WaitAckStopEpoch`` ->
+``WaitAckStartEpoch`` -> ``WaitAckDropEpoch`` (§3.5 of SURVEY.md), and
+``handleRequestActiveReplicas``:889.  Every RC-record mutation is a paxos
+commit on the reconfigurators' own RSM (:mod:`.rc_app`); the record
+OWNER (first on the RC consistent-hash ring) drives the protocol tasks
+when the commit executes (``CommitWorker`` + primary semantics).
+
+Row allocation (TPU-specific): the engine aligns groups across replicas
+by row index, so every member must host a name's epoch at the SAME row.
+The RC derives a candidate row from hash(name:epoch) and carries it in
+StartEpoch; a member whose row is occupied NACKs, and the start task
+re-probes (hash+attempt) until a row clears on a majority — converging
+because capacity G far exceeds live names (PINSTANCES_CAPACITY 2M analog).
+"""
+
+from __future__ import annotations
+
+import json
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..manager import PaxosManager
+from ..protocoltask import ProtocolExecutor, ProtocolTask, ThresholdProtocolTask
+from .chash import ConsistentHashing
+from .rc_app import (
+    COMPLETE,
+    CREATE_INTENT,
+    DELETE_FINAL,
+    DELETE_INTENT,
+    RECONFIGURE_INTENT,
+    STOP_DONE,
+    RCRecordsApp,
+)
+from .record import RCState
+
+Addr = Tuple[str, int]
+
+# The reconfigurators' record RSM: one paxos group among all RCs on the
+# RC cluster's own engine (RepliconfigurableReconfiguratorDB analog).
+RC_GROUP = "__RC_RECORDS__"
+
+
+def row_for(name: str, epoch: int, attempt: int, n_groups: int) -> int:
+    return (zlib.crc32(f"{name}:{epoch}".encode()) + attempt) % n_groups
+
+
+class StartEpochTask(ProtocolTask):
+    """WaitAckStartEpoch analog with row-probe NACK retry."""
+
+    restart_period_s = 1.0
+    max_lifetime_s = 30.0
+
+    def __init__(self, key: str, rcf: "Reconfigurator", op: Dict):
+        super().__init__(key)
+        self.rcf = rcf
+        self.op = op  # {name, epoch, actives, prev_actives, prev_epoch, initial_state}
+        self.attempt = int(op.get("attempt", 0))
+        self.acked: set = set()
+        self.majority = len(op["actives"]) // 2 + 1
+
+    @property
+    def row(self) -> int:
+        return row_for(
+            self.op["name"], int(self.op["epoch"]), self.attempt,
+            self.rcf.n_groups,
+        )
+
+    def start(self):
+        out = []
+        for a in self.op["actives"]:
+            if a not in self.acked:
+                out.append((("AR", a), "start_epoch", {
+                    "name": self.op["name"], "epoch": self.op["epoch"],
+                    "actives": self.op["actives"], "row": self.row,
+                    "attempt": self.attempt,
+                    "initial_state": self.op.get("initial_state"),
+                    "prev_actives": self.op.get("prev_actives") or [],
+                    "prev_epoch": self.op.get("prev_epoch", -1),
+                    "rc": ["RC", self.rcf.my_id],
+                }))
+        return out
+
+    def handle_event(self, kind: str, body: Dict):
+        if kind != "ack_start_epoch" or int(body["row"]) != self.row:
+            return ()
+        if not body.get("ok"):
+            # row collision somewhere: probe the next candidate everywhere
+            self.attempt += 1
+            self.acked.clear()
+            return self.start()
+        self.acked.add(int(body["from"]))
+        if len(self.acked) >= self.majority:
+            self.done = True
+            # commit COMPLETE (with the row that won) through RC paxos;
+            # prev-epoch info rides along so the applied callback can GC it
+            self.rcf.propose_op({
+                "op": COMPLETE, "name": self.op["name"], "row": self.row,
+                "prev_actives": self.op.get("prev_actives") or [],
+                "prev_epoch": self.op.get("prev_epoch", -1),
+            })
+        return ()
+
+
+class StopEpochTask(ThresholdProtocolTask):
+    """WaitAckStopEpoch analog: majority-stop the old epoch."""
+
+    restart_period_s = 1.0
+    max_lifetime_s = 30.0
+
+    def __init__(self, key: str, rcf: "Reconfigurator", name: str,
+                 epoch: int, actives: List[int],
+                 on_stopped: Callable[[], None]):
+        super().__init__(key, actives)  # majority threshold default
+        self.rcf = rcf
+        self.name = name
+        self.epoch = epoch
+        self._on_stopped = on_stopped
+
+    def send_to(self, node):
+        return (("AR", node), "stop_epoch", {
+            "name": self.name, "epoch": self.epoch,
+            "rc": ["RC", self.rcf.my_id],
+        })
+
+    def is_ack(self, kind, body):
+        if kind == "ack_stop_epoch" and body["name"] == self.name \
+                and int(body["epoch"]) == self.epoch:
+            return int(body["from"])
+        return None
+
+    def on_threshold(self):
+        self._on_stopped()
+        return ()
+
+
+class DropEpochTask(ThresholdProtocolTask):
+    """WaitAckDropEpoch analog: GC the old epoch everywhere (best effort —
+    expiry just leaves stragglers' rows to a later drop/cleanup)."""
+
+    restart_period_s = 2.0
+    max_lifetime_s = 60.0
+
+    def __init__(self, key: str, rcf: "Reconfigurator", name: str,
+                 epoch: int, actives: List[int],
+                 on_done: Optional[Callable[[], None]] = None):
+        super().__init__(key, actives, threshold=len(actives))
+        self.rcf = rcf
+        self.name = name
+        self.epoch = epoch
+        self._on_done = on_done
+
+    def send_to(self, node):
+        return (("AR", node), "drop_epoch", {
+            "name": self.name, "epoch": self.epoch,
+            "rc": ["RC", self.rcf.my_id],
+        })
+
+    def is_ack(self, kind, body):
+        if kind == "ack_drop_epoch" and body["name"] == self.name \
+                and int(body["epoch"]) == self.epoch:
+            return int(body["from"])
+        return None
+
+    def on_threshold(self):
+        self._fire_done()
+        return ()
+
+    def on_expire(self):
+        # Best-effort GC: a dead active must not wedge the chain forever
+        # (the delete path gates DELETE_FINAL on this).  Stragglers' rows
+        # are reclaimed when they next hear a drop or are replaced — the
+        # reference's MAX_FINAL_STATE_AGE age-out plays the same role.
+        self._fire_done()
+
+    def _fire_done(self):
+        cb, self._on_done = self._on_done, None
+        if cb is not None:
+            cb()
+
+
+class Reconfigurator:
+    def __init__(
+        self,
+        my_id: int,
+        rc_manager: PaxosManager,
+        rc_app: RCRecordsApp,
+        actives: List[int],
+        reconfigurators: List[int],
+        send: Callable[[Addr, str, Dict], None],
+        default_replicas: int = 3,   # RC.DEFAULT_NUM_REPLICAS analog
+    ):
+        self.my_id = int(my_id)
+        self.rc_manager = rc_manager
+        self.rc_app = rc_app
+        self.send = send
+        self.n_groups = rc_manager.cfg.n_groups  # row space of the AR engine
+        self.default_replicas = default_replicas
+        self.ar_ring = ConsistentHashing(actives)
+        self.rc_ring = ConsistentHashing(reconfigurators)
+        self.tasks = ProtocolExecutor(send=lambda m: self.send(m[0], m[1], m[2]))
+        # client replies owed on COMPLETE / DELETE_FINAL: name -> client addr
+        self._pending_clients: Dict[str, Any] = {}
+        rc_app.on_applied = self._on_applied
+
+    # ------------------------------------------------------------------
+    def is_primary(self, name: str) -> bool:
+        """Record owner = first RC on the ring (WaitPrimaryExecution's
+        primary; secondary takeover is a failure-handling extension)."""
+        return self.rc_ring.get_node(name) == self.my_id
+
+    def propose_op(self, op: Dict) -> None:
+        """Commit an RC-record mutation through the RC paxos group
+        (CommitWorker semantics: the protocol task retransmits around it)."""
+        self.rc_manager.propose(RC_GROUP, json.dumps(op))
+
+    # ------------------------------------------------------------------
+    # client/admin ingress
+    # ------------------------------------------------------------------
+    def handle_message(self, kind: str, body: Dict, frm: Optional[Any] = None) -> None:
+        if kind == "create_service":
+            self._handle_create(body)
+        elif kind == "delete_service":
+            self._handle_delete(body)
+        elif kind == "reconfigure":
+            self._handle_reconfigure(body)
+        elif kind == "request_actives":
+            self._handle_request_actives(body)
+        elif kind in ("ack_start_epoch",):
+            name = body["name"]
+            self.tasks.handle_event(f"start:{name}", kind, body)
+        elif kind in ("ack_stop_epoch",):
+            self.tasks.handle_event(f"stop:{body['name']}", kind, body)
+        elif kind in ("ack_drop_epoch",):
+            self.tasks.handle_event(f"drop:{body['name']}", kind, body)
+
+    def tick(self, now: Optional[float] = None) -> None:
+        self.tasks.tick(now)
+
+    # ---- create (handleCreateServiceName, Reconfigurator.java:484) -----
+    def _handle_create(self, body: Dict) -> None:
+        name = body["name"]
+        if not self.is_primary(name):
+            # forward to the owner (the reference redirects via the ring)
+            self.send(("RC", self.rc_ring.get_node(name)), "create_service", body)
+            return
+        rec = self.rc_app.get_record(name)
+        if rec is not None and not rec.deleted:
+            if rec.state is RCState.WAIT_ACK_START and not rec.actives:
+                # creation still in flight: a client retransmit re-registers
+                # for the eventual COMPLETE reply instead of a false "exists"
+                if body.get("client") is not None:
+                    self._pending_clients[name] = body["client"]
+                return
+            self._reply(body, "create_ack", name, ok=False, reason="exists")
+            return
+        actives = body.get("actives") or self.ar_ring.get_replicated_servers(
+            name, self.default_replicas
+        )
+        if body.get("client") is not None:
+            self._pending_clients[name] = body["client"]
+        self.propose_op({
+            "op": CREATE_INTENT, "name": name, "epoch": 0,
+            "actives": actives, "row": row_for(name, 0, 0, self.n_groups),
+            "initial_state": body.get("initial_state"),
+        })
+
+    # ---- reconfigure (epoch e -> e+1, §3.5) ----------------------------
+    def _handle_reconfigure(self, body: Dict) -> None:
+        name = body["name"]
+        if not self.is_primary(name):
+            self.send(("RC", self.rc_ring.get_node(name)), "reconfigure", body)
+            return
+        rec = self.rc_app.get_record(name)
+        if rec is None or rec.deleted:
+            self._reply(body, "reconfigure_ack", name, ok=False,
+                        reason="not-ready")
+            return
+        if rec.state is not RCState.READY:
+            if rec.new_actives == list(body["new_actives"]):
+                # same migration already in flight: a client retransmit
+                # re-registers for the eventual COMPLETE reply
+                if body.get("client") is not None:
+                    self._pending_clients[name] = body["client"]
+            else:
+                self._reply(body, "reconfigure_ack", name, ok=False,
+                            reason="not-ready")
+            return
+        new_actives = body["new_actives"]
+        if body.get("client") is not None:
+            self._pending_clients[name] = body["client"]
+        self.propose_op({
+            "op": RECONFIGURE_INTENT, "name": name,
+            "new_actives": new_actives,
+            "new_row": row_for(name, rec.epoch + 1, 0, self.n_groups),
+        })
+
+    # ---- delete (two-phase, Reconfigurator.java:747) -------------------
+    def _handle_delete(self, body: Dict) -> None:
+        name = body["name"]
+        if not self.is_primary(name):
+            self.send(("RC", self.rc_ring.get_node(name)), "delete_service", body)
+            return
+        rec = self.rc_app.get_record(name)
+        if rec is None or rec.deleted:
+            self._reply(body, "delete_ack", name, ok=False, reason="unknown")
+            return
+        if body.get("client") is not None:
+            self._pending_clients[name] = body["client"]
+        self.propose_op({"op": DELETE_INTENT, "name": name})
+
+    # ---- reads (handleRequestActiveReplicas, :889) ---------------------
+    def _handle_request_actives(self, body: Dict) -> None:
+        rec = self.rc_app.get_record(body["name"])
+        ok = rec is not None and not rec.deleted and bool(rec.actives)
+        self._reply(body, "actives_response", body["name"], ok=ok,
+                    actives=(rec.actives if ok else []),
+                    epoch=(rec.epoch if ok else -1),
+                    row=(rec.row if ok else -1))
+
+    def _reply(self, body: Dict, kind: str, name: str, **fields) -> None:
+        client = body.get("client")
+        if client is not None:
+            self.send(tuple(client), kind, {"name": name, **fields})
+
+    # ------------------------------------------------------------------
+    # RC-record commit callbacks (CommitWorker execution path)
+    # ------------------------------------------------------------------
+    def _on_applied(self, op: Dict) -> None:
+        """Fires on EVERY reconfigurator when an RC-record op executes;
+        only the record's primary drives the next protocol step."""
+        name = op["name"]
+        if not op.get("applied") or not self.is_primary(name):
+            return
+        rec = self.rc_app.get_record(name)
+        kind = op["op"]
+        if kind == CREATE_INTENT:
+            self.tasks.spawn_if_not_running(
+                f"start:{name}",
+                lambda: StartEpochTask(f"start:{name}", self, {
+                    "name": name, "epoch": op.get("epoch", 0),
+                    "actives": op["actives"],
+                    "initial_state": op.get("initial_state"),
+                }),
+            )
+        elif kind == RECONFIGURE_INTENT:
+            assert rec is not None
+            self.tasks.spawn_if_not_running(
+                f"stop:{name}",
+                lambda: StopEpochTask(
+                    f"stop:{name}", self, name, rec.epoch, rec.actives,
+                    on_stopped=lambda: self.propose_op(
+                        {"op": STOP_DONE, "name": name}
+                    ),
+                ),
+            )
+        elif kind == STOP_DONE:
+            assert rec is not None
+            self.tasks.spawn_if_not_running(
+                f"start:{name}",
+                lambda: StartEpochTask(f"start:{name}", self, {
+                    "name": name, "epoch": rec.epoch + 1,
+                    "actives": rec.new_actives,
+                    "prev_actives": rec.actives,
+                    "prev_epoch": rec.epoch,
+                }),
+            )
+        elif kind == COMPLETE:
+            assert rec is not None
+            was_create = not op.get("prev_actives")
+            client = self._pending_clients.pop(name, None)
+            if client is not None:
+                self.send(tuple(client),
+                          "create_ack" if was_create else "reconfigure_ack",
+                          {"name": name, "ok": True, "actives": rec.actives,
+                           "epoch": rec.epoch})
+            if not was_create:
+                # GC the previous epoch on its old actives
+                prev_actives = list(op.get("prev_actives") or [])
+                prev_epoch = int(op.get("prev_epoch", rec.epoch - 1))
+                self.tasks.spawn_if_not_running(
+                    f"drop:{name}",
+                    lambda: DropEpochTask(
+                        f"drop:{name}", self, name, prev_epoch, prev_actives,
+                    ),
+                )
+        elif kind == DELETE_INTENT:
+            assert rec is not None
+            # stop the live epoch, then drop it everywhere, then purge the
+            # record (two-phase delete; the final-state age-out of the
+            # reference is subsumed by the explicit drop round)
+            epoch, actives = rec.epoch, list(rec.actives)
+
+            def after_drop():
+                self.propose_op({"op": DELETE_FINAL, "name": name})
+
+            def after_stop():
+                self.tasks.spawn_if_not_running(
+                    f"drop:{name}",
+                    lambda: DropEpochTask(
+                        f"drop:{name}", self, name, epoch, actives,
+                        on_done=after_drop,
+                    ),
+                )
+
+            self.tasks.spawn_if_not_running(
+                f"stop:{name}",
+                lambda: StopEpochTask(
+                    f"stop:{name}", self, name, epoch, actives,
+                    on_stopped=after_stop,
+                ),
+            )
+        elif kind == DELETE_FINAL:
+            client = self._pending_clients.pop(name, None)
+            if client is not None:
+                self.send(tuple(client), "delete_ack",
+                          {"name": name, "ok": True})

@@ -74,10 +74,13 @@ class ReconfigurationRecord:
         return True
 
     def complete(self) -> bool:
-        """COMPLETE: majority of new actives running epoch e+1 (-> READY)."""
+        """COMPLETE: majority of new actives running the target epoch
+        (-> READY).  For an initial create (no prior actives) the epoch
+        stays as born; for a reconfiguration it advances e -> e+1."""
         if self.state is not RCState.WAIT_ACK_START:
             return False
-        self.epoch += 1
+        if self.actives:
+            self.epoch += 1
         self.actives = list(self.new_actives)
         self.row = self.new_row
         self.new_actives = []

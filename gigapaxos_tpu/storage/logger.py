@@ -41,7 +41,9 @@ class RecoveredState:
         self.arrays = arrays          # None => fresh start
         self.meta = meta
         self.payloads = payloads      # vid -> request string (host arena)
-        self.names = names            # name -> {row, version, init} (post-ckpt creates)
+        # name -> [{row, version, init}, ...] in journal order (a name can
+        # appear once per epoch: reconfiguration re-creates it at a new row)
+        self.names = names
 
 
 class PaxosLogger:
@@ -138,7 +140,7 @@ class PaxosLogger:
             arrays = {k: v.copy() for k, v in arrays_ro.items()}
             from_file, from_off = meta.get("journal_pos", [0, 0])
         payloads: Dict[int, str] = {}
-        names: Dict[str, Dict[str, Any]] = {}
+        names: Dict[str, List[Dict[str, Any]]] = {}
         for btype, payload, n_rows, _pos in self.journal.scan(from_file, from_off):
             if btype == BlockType.PAYLOADS:
                 payloads.update(
@@ -147,7 +149,7 @@ class PaxosLogger:
                 continue
             if btype == BlockType.NAMES:
                 for ent in json.loads(payload.decode("utf-8")):
-                    names[ent["name"]] = ent
+                    names.setdefault(ent["name"], []).append(ent)
                 continue
             if btype == BlockType.CHECKPOINT:
                 continue

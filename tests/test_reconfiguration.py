@@ -1,0 +1,252 @@
+"""Reconfiguration-layer integration tests — the ``loopback_rc_simple``
+parity suite (ref: ``tests/loopback_rc_simple/`` +
+``TESTReconfigurationClient.java:676-1078``): create a name through the
+reconfigurators, run requests, migrate the replica set (epoch n -> n+1
+with final-state handoff to a fresh active), verify state continuity and
+old-epoch GC, delete the name; plus unit tests of the ring and records.
+"""
+
+import numpy as np
+import pytest
+
+from gigapaxos_tpu.models.apps import HashChainApp
+from gigapaxos_tpu.ops.engine import EngineConfig
+from gigapaxos_tpu.reconfiguration import (
+    ConsistentHashing,
+    RCState,
+    ReconfigurationRecord,
+)
+from gigapaxos_tpu.testing.rc_cluster import ReconfigurableCluster
+
+
+# ---------------------------------------------------------------------------
+# units
+# ---------------------------------------------------------------------------
+
+def test_consistent_hashing_stability_and_spread():
+    ch = ConsistentHashing([0, 1, 2, 3, 4])
+    names = [f"name{i}" for i in range(500)]
+    place = {n: ch.get_node(n) for n in names}
+    # deterministic
+    assert place == {n: ch.get_node(n) for n in names}
+    # k distinct replicas
+    for n in names[:20]:
+        reps = ch.get_replicated_servers(n, 3)
+        assert len(reps) == len(set(reps)) == 3
+    # removing a node only moves that node's names (ring locality)
+    ch2 = ConsistentHashing([0, 1, 2, 3])
+    moved = [n for n in names if place[n] != ch2.get_node(n) and place[n] != 4]
+    assert len(moved) < len(names) * 0.2
+
+
+def test_record_lifecycle():
+    r = ReconfigurationRecord("svc", actives=[0, 1, 2], row=3)
+    assert not r.stop_done()  # invalid from READY
+    assert r.start_reconfigure([1, 2, 3], 9)
+    assert not r.start_reconfigure([1, 2, 3], 9)  # not from WAIT_ACK_STOP
+    assert r.stop_done() and r.complete()
+    assert (r.epoch, r.actives, r.row, r.state) == (1, [1, 2, 3], 9, RCState.READY)
+    assert r.start_delete() and r.finish_delete() and r.deleted
+    rt = ReconfigurationRecord.from_json(r.to_json())
+    assert rt == r
+
+
+# ---------------------------------------------------------------------------
+# integration: the loopback_rc_simple parity flow
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def cluster():
+    ar_cfg = EngineConfig(n_groups=32, window=8, req_lanes=4, n_replicas=4)
+    rc_cfg = EngineConfig(n_groups=8, window=8, req_lanes=4, n_replicas=3)
+    c = ReconfigurableCluster(ar_cfg, rc_cfg, HashChainApp)
+    yield c
+    c.close()
+
+
+def _run_requests(c, name, values, entry):
+    done = {}
+    mgr = c.ars.managers[entry]
+    for v in values:
+        mgr.propose(name, v, callback=lambda rid, resp: done.setdefault(rid, resp))
+    for _ in range(40):
+        if len(done) == len(values):
+            break
+        c.step()
+    assert len(done) == len(values), f"only {len(done)}/{len(values)} executed"
+    return done
+
+
+def test_create_request_migrate_delete(cluster):
+    c = cluster
+    # --- create via the reconfigurators (any RC; forwarded to the owner) --
+    c.client_request("create_service", {
+        "name": "svc", "actives": [0, 1, 2], "initial_state": None,
+    }, rc=0)
+    ack = c.wait_for("create_ack")
+    assert ack and ack["ok"], ack
+    assert sorted(ack["actives"]) == [0, 1, 2] and ack["epoch"] == 0
+
+    # --- request_actives read --------------------------------------------
+    c.client_request("request_actives", {"name": "svc"}, rc=1)
+    resp = c.wait_for("actives_response")
+    assert resp["ok"] and sorted(resp["actives"]) == [0, 1, 2]
+
+    # --- app requests through epoch 0 ------------------------------------
+    _run_requests(c, "svc", [f"r{i}" for i in range(5)], entry=0)
+    apps = [c.ars.managers[i].app for i in range(4)]
+    h0 = apps[0].state["svc"]
+    assert apps[1].state["svc"] == h0 and apps[2].state["svc"] == h0
+    assert "svc" not in apps[3].state  # node 3 not a member yet
+
+    # --- migrate [0,1,2] -> [1,2,3] (node 3 fetches the final state) -----
+    c.client_request("reconfigure", {"name": "svc", "new_actives": [1, 2, 3]})
+    ack = c.wait_for("reconfigure_ack", max_steps=120)
+    assert ack and ack["ok"], ack
+    assert sorted(ack["actives"]) == [1, 2, 3] and ack["epoch"] == 1
+
+    # state continuity: the new epoch resumed from the stop-time hash chain
+    for _ in range(30):  # let drops settle
+        c.step()
+    n1 = apps[1].n_executed["svc"]
+    assert apps[3].state["svc"] == apps[1].state["svc"] == apps[2].state["svc"]
+    # old epoch dropped: node 0's row freed, name forgotten
+    assert c.ars.managers[0].names.get("svc") is None
+    assert c.ars.managers[1].old_epochs == {}
+
+    # --- requests keep flowing in epoch 1 (entry = node 1) ----------------
+    _run_requests(c, "svc", [f"s{i}" for i in range(4)], entry=1)
+    assert apps[1].n_executed["svc"] == n1 + 4
+    assert apps[3].state["svc"] == apps[1].state["svc"]
+
+    # --- two-phase delete -------------------------------------------------
+    c.client_request("delete_service", {"name": "svc"})
+    ack = c.wait_for("delete_ack", max_steps=120)
+    assert ack and ack["ok"], ack
+    for _ in range(5):
+        c.step()
+    for i in (1, 2, 3):
+        assert c.ars.managers[i].names.get("svc") is None
+    # record purged on every reconfigurator
+    for rc in c.reconfigurators:
+        assert rc.rc_app.get_record("svc") is None
+
+    # --- name reusable after delete (create -> epoch 0 again) -------------
+    c.client_request("create_service", {"name": "svc", "actives": [0, 2, 3]})
+    ack = c.wait_for("create_ack", max_steps=120)
+    assert ack and ack["ok"] and sorted(ack["actives"]) == [0, 2, 3]
+
+
+def test_create_duplicate_rejected(cluster):
+    c = cluster
+    c.client_request("create_service", {"name": "dup"})
+    ack = c.wait_for("create_ack", max_steps=120)
+    assert ack and ack["ok"]
+    c.client_request("create_service", {"name": "dup"})
+    ack2 = c.wait_for("create_ack", max_steps=120)
+    assert ack2 and not ack2["ok"] and ack2["reason"] == "exists"
+
+
+def test_reconfigure_unknown_name_rejected(cluster):
+    c = cluster
+    c.client_request("reconfigure", {"name": "ghost", "new_actives": [0, 1, 2]})
+    ack = c.wait_for("reconfigure_ack", max_steps=60)
+    assert ack and not ack["ok"]
+
+
+def test_stale_stop_epoch_cannot_stop_live_epoch(cluster):
+    """A delayed duplicate stop_epoch(e) arriving after the move to e+1
+    must not stop the live e+1 group (review finding: the stale stop would
+    otherwise wedge the new epoch forever)."""
+    c = cluster
+    c.client_request("create_service", {"name": "stale", "actives": [0, 1, 2]})
+    ack = c.wait_for("create_ack", max_steps=120)
+    assert ack and ack["ok"]
+    c.client_request("reconfigure", {"name": "stale", "new_actives": [1, 2, 3]})
+    ack = c.wait_for("reconfigure_ack", max_steps=120)
+    assert ack and ack["ok"] and ack["epoch"] == 1
+    for _ in range(10):
+        c.step()
+    # replay the old epoch's stop at an active of the NEW epoch
+    c.active_replicas[1].handle_message(
+        "stop_epoch", {"name": "stale", "epoch": 0, "rc": ["RC", 0]}
+    )
+    for _ in range(10):
+        c.step()
+    mgr = c.ars.managers[1]
+    assert not mgr.is_stopped("stale"), "stale stop wedged the live epoch"
+    _run_requests(c, "stale", ["x", "y"], entry=1)  # still serving
+
+
+def test_delete_completes_with_dead_active(monkeypatch):
+    """A crashed active must not wedge the two-phase delete: the drop round
+    expires best-effort and DELETE_FINAL still commits (MAX_FINAL_STATE_AGE
+    age-out analog)."""
+    from gigapaxos_tpu.reconfiguration import reconfigurator as rc_mod
+
+    monkeypatch.setattr(rc_mod.DropEpochTask, "max_lifetime_s", 0.3)
+    monkeypatch.setattr(rc_mod.DropEpochTask, "restart_period_s", 0.05)
+    ar_cfg = EngineConfig(n_groups=16, window=8, req_lanes=4, n_replicas=3)
+    rc_cfg = EngineConfig(n_groups=8, window=8, req_lanes=4, n_replicas=3)
+    c = ReconfigurableCluster(ar_cfg, rc_cfg, HashChainApp)
+    try:
+        c.client_request("create_service", {"name": "dd", "actives": [0, 1, 2]})
+        ack = c.wait_for("create_ack", max_steps=120)
+        assert ack and ack["ok"]
+        # node 2 goes dark for the reconfiguration plane
+        c.msg_filter = lambda dst, kind, body: dst != ("AR", 2)
+        c.client_request("delete_service", {"name": "dd"})
+        ack = c.wait_for("delete_ack", max_steps=300)
+        assert ack and ack["ok"], ack
+        for rc in c.reconfigurators:
+            assert rc.rc_app.get_record("dd") is None
+    finally:
+        c.close()
+
+
+def test_migration_survives_lossy_control_plane(monkeypatch):
+    """Drop 30% of reconfiguration-plane messages: the WaitAck* tasks'
+    retransmits must still drive the epoch change to completion (the
+    reference's task restarts, ProtocolExecutor.java periodic restart)."""
+    from gigapaxos_tpu.reconfiguration import active_replica as ar_mod
+    from gigapaxos_tpu.reconfiguration import reconfigurator as rc_mod
+
+    # fast retransmit so wall-clock restarts fire between test steps
+    for cls in (rc_mod.StartEpochTask, rc_mod.StopEpochTask,
+                rc_mod.DropEpochTask, ar_mod.WaitEpochFinalState):
+        monkeypatch.setattr(cls, "restart_period_s", 0.02)
+
+    ar_cfg = EngineConfig(n_groups=16, window=8, req_lanes=4, n_replicas=4)
+    rc_cfg = EngineConfig(n_groups=8, window=8, req_lanes=4, n_replicas=3)
+    c = ReconfigurableCluster(ar_cfg, rc_cfg, HashChainApp)
+    try:
+        rng = np.random.RandomState(7)
+        c.msg_filter = lambda dst, kind, body: rng.rand() > 0.3
+
+        def request_with_retry(kind, body, ack_kind, tries=8, max_steps=60):
+            # client-side retransmission (PaxosClientAsync timeout analog):
+            # the op itself is idempotent on the record state machine
+            for _ in range(tries):
+                c.client_request(kind, dict(body))
+                ack = c.wait_for(ack_kind, max_steps=max_steps)
+                if ack is not None:
+                    return ack
+            return None
+
+        ack = request_with_retry(
+            "create_service", {"name": "lossy", "actives": [0, 1, 2]},
+            "create_ack",
+        )
+        assert ack and ack["ok"], ack
+        _run_requests(c, "lossy", ["a", "b", "c"], entry=1)
+        ack = request_with_retry(
+            "reconfigure", {"name": "lossy", "new_actives": [1, 2, 3]},
+            "reconfigure_ack", max_steps=100,
+        )
+        assert ack and ack["ok"], ack
+        apps = [m.app for m in c.ars.managers]
+        for _ in range(20):
+            c.step()
+        assert apps[3].state["lossy"] == apps[1].state["lossy"]
+    finally:
+        c.close()
