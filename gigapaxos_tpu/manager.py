@@ -110,6 +110,10 @@ class PaxosManager:
             PaxosLogger(my_id, log_dir, sync=sync_journal) if log_dir else None
         )
         self.checkpoint_every = checkpoint_every
+        # members lagging more than this many slots behind the majority
+        # are written off for payload retention and recover via checkpoint
+        # transfer (MAX_SYNC_DECISIONS_GAP analog)
+        self.jump_horizon = 4 * cfg.window
 
         # host-side tables
         self.names: Dict[str, int] = {}        # service name -> CURRENT epoch row
@@ -134,6 +138,12 @@ class PaxosManager:
         # deterministic across the group — at-least-once commit,
         # exactly-once execution; ref: PaxosManager.java:318-346).
         self.response_cache: Dict[int, Tuple[float, Optional[str]]] = {}
+        # in-flight dedup (the reference's outstanding-table propose dedup,
+        # PaxosManager.java:1209): a retransmitted request id whose original
+        # proposal is still queued locally must not mint a second vid —
+        # duplicate decisions of one logical request are legal but wasteful,
+        # and post-jump replicas can't dedup them (no cache entry yet)
+        self.inflight: Dict[int, int] = {}  # request_id -> queued vid
         self._next_counter = 1
         self.queues: Dict[int, List[int]] = {}  # group row -> pending vids
         self.forward_out: List[Tuple[int, str, Dict]] = []  # (dst, kind, body)
@@ -150,6 +160,7 @@ class PaxosManager:
         self._tick_no = 0
         self.total_executed = 0
         self._slots_since_ckpt = 0
+        self._last_state_req: Dict[int, int] = {}  # row -> tick of last pull
 
         # serializes self.state replacement between the tick loop and
         # lifecycle ops arriving on transport threads (create/kill/recover)
@@ -448,6 +459,15 @@ class PaxosManager:
             if request_id is not None and request_id in self.response_cache:
                 cached_hit = True
                 cached_response = self.response_cache[request_id][1]
+            elif (
+                request_id is not None
+                and self.inflight.get(request_id) in self.vid_meta
+            ):
+                # original proposal still live here: refresh the callback
+                # (the client re-registered) and wait for execution
+                if callback is not None:
+                    self.outstanding.put(request_id, callback)
+                return None
             else:
                 if self._next_counter > VID_COUNTER_MASK:
                     raise RuntimeError("vid counter space exhausted")
@@ -459,6 +479,7 @@ class PaxosManager:
                     vid |= STOP_BIT
                 self.arena[vid] = request_value
                 self.vid_meta[vid] = (entry, request_id)
+                self.inflight[request_id] = vid
                 if callback is not None:
                     self.outstanding.put(request_id, callback)
                 self.queues.setdefault(row, []).append(vid)
@@ -498,6 +519,12 @@ class PaxosManager:
                 stop=body.get("stop", False),
                 request_id=body.get("request_id"),
                 entry_replica=body.get("entry", None),
+            )
+        elif kind == "state_request":  # checkpoint-transfer pull
+            self._serve_state_request(body)
+        elif kind == "state_reply":
+            self._apply_state_reply(
+                body["states"], body.get("response_cache") or {}
             )
         elif kind == "need_payloads":  # straggler pull (sync analog)
             have = {v: self.arena[v] for v in body["vids"] if v in self.arena}
@@ -615,9 +642,16 @@ class PaxosManager:
             if r != self.my_id else self.app_exec_slot
             for r in range(R)
         ])
-        cur_masked = np.where(in_group, cursors, np.iinfo(np.int64).max)
+        # A member more than JUMP_HORIZON behind the majority frontier no
+        # longer holds the payload-retention watermark down: it can never
+        # catch up through the rings and will recover via checkpoint
+        # transfer instead (state_request/state_reply below) — without
+        # this, one dead member pins every payload forever.
+        horizon = out_np.maj_exec.astype(np.int64) - self.jump_horizon
+        eligible = in_group & (cursors >= horizon[None, :])
+        cur_masked = np.where(eligible, cursors, np.iinfo(np.int64).max)
         self._min_exec = np.where(
-            in_group.any(axis=0), cur_masked.min(axis=0), self._min_exec
+            eligible.any(axis=0), cur_masked.min(axis=0), self._min_exec
         )
         # requeue what wasn't admitted
         n_adm = out_np.n_admitted
@@ -658,7 +692,14 @@ class PaxosManager:
                 self.logger.log_payloads(payload_delta)
 
         self._execute(out_np)
+        self._maybe_request_state(out_np)
         self.outstanding.gc()
+        if self._tick_no % 64 == 0 and self.inflight:
+            # entries whose vid left vid_meta (forwarded to a coordinator /
+            # GC'd) no longer gate re-proposal
+            self.inflight = {
+                r: v for r, v in self.inflight.items() if v in self.vid_meta
+            }
         self._maybe_checkpoint(out_np)
 
         host_delta = {
@@ -758,6 +799,7 @@ class PaxosManager:
             raise RuntimeError(f"app refused to execute {name}:{slot}")
         self.total_executed += 1
         self._slots_since_ckpt += 1
+        self.inflight.pop(request_id, None)
         if (vid & STOP_BIT) and self.on_stop_executed is not None and name:
             epoch = int(np.asarray(self.state.version)[g])
             try:
@@ -772,6 +814,174 @@ class PaxosManager:
                 self._fired_callbacks.append((cb, request_id, response))
         self.retained[vid] = (g, slot)  # keep for straggler pulls
         return True
+
+    # ------------------------------------------------------------------
+    # checkpoint transfer for stragglers (StatePacket / handleCheckpoint,
+    # PaxosInstanceStateMachine.java:1744; jumpSlot, PaxosAcceptor.java:538)
+    # ------------------------------------------------------------------
+    STATE_REQ_INTERVAL = 16  # ticks between pulls for the same row
+
+    def _maybe_request_state(self, out_np) -> None:
+        """Detect rows needing a state pull: (a) device frontier stranded
+        beyond the ring window — the decisions it needs left every peer's
+        [G, W] ring (the SyncDecisionsPacket 'isMissingTooMuch' case), or
+        (b) the APP cursor stranded behind the local device frontier past
+        the retention horizon — the payloads it needs were GC'd everywhere
+        (only the app state + cursor need transfer, not an engine jump)."""
+        W = self.cfg.window
+        exec_np = np.asarray(self.state.exec_slot)
+        behind_dev = (out_np.maj_exec - exec_np) > W
+        behind_app = (exec_np - self.app_exec_slot) > self.jump_horizon
+        need = behind_dev | behind_app
+        if not need.any():
+            return
+        versions = np.asarray(self.state.version)
+        masks = np.asarray(self.state.member_mask)
+        by_dst: Dict[int, List[Dict]] = {}
+        for g in np.nonzero(need)[0]:
+            g = int(g)
+            name = self.row_name.get(g)
+            if name is None or self.names.get(name) != g:
+                continue  # only current-epoch mappings pull state
+            if self._tick_no - self._last_state_req.get(g, -(10 ** 9)) \
+                    < self.STATE_REQ_INTERVAL:
+                continue
+            self._last_state_req[g] = self._tick_no
+            # one donor per request, rotated across the membership so a
+            # dead/lagging donor doesn't wedge the pull (and the broadcast
+            # doesn't N-plicate O(cache) replies)
+            members = [r for r in range(32)
+                       if (int(masks[g]) >> r) & 1 and r != self.my_id]
+            if not members:
+                continue
+            dst = members[(self._tick_no // self.STATE_REQ_INTERVAL) % len(members)]
+            by_dst.setdefault(dst, []).append(
+                {"row": g, "name": name, "version": int(versions[g])}
+            )
+        for dst, rows in by_dst.items():
+            self.forward_out.append(
+                (dst, "state_request", {"rows": rows, "from": self.my_id})
+            )
+
+    def _serve_state_request(self, body: Dict) -> None:
+        """Serve a consistent (device frontier == app cursor) snapshot of
+        each requested row; skip rows where the two disagree — the
+        requester retries and another peer may be quiescent."""
+        exec_np = np.asarray(self.state.exec_slot)
+        states = []
+        for ent in body["rows"]:
+            g, name = int(ent["row"]), ent["name"]
+            if self.names.get(name) != g:
+                continue
+            if int(np.asarray(self.state.version)[g]) != int(ent["version"]):
+                continue
+            frontier = int(exec_np[g])
+            if int(self.app_exec_slot[g]) != frontier:
+                continue  # app cursor lags the device: snapshot inconsistent
+            states.append({
+                "row": g, "name": name, "version": int(ent["version"]),
+                "exec": frontier,
+                "bal": int(np.asarray(self.state.bal)[g]),
+                "app_hash": int(np.asarray(self.state.app_hash)[g]),
+                "n_execd": int(np.asarray(self.state.n_execd)[g]),
+                "stopped": int(np.asarray(self.state.stopped)[g]),
+                "app_state": self.app.checkpoint(name),
+            })
+        if states:
+            # Response-cache entries for the served rows ride along:
+            # without them the receiver cannot dedup a duplicate decision
+            # (same request id, different vid) landing after its jumped
+            # frontier — replicas that executed the first copy skip it, a
+            # jumped replica would execute it and diverge.  Filtered to the
+            # requested rows via the retained-payload index (the unfiltered
+            # cache spans every group).
+            served = {int(s["row"]) for s in states}
+            cache = {}
+            for vid, (row, _slot) in self.retained.items():
+                if row in served and vid in self.vid_meta:
+                    rid = self.vid_meta[vid][1]
+                    if rid in self.response_cache:
+                        cache[str(rid)] = self.response_cache[rid][1]
+            self.forward_out.append(
+                (body["from"], "state_reply",
+                 {"states": states, "response_cache": cache})
+            )
+
+    def _apply_state_reply(
+        self, states: List[Dict], response_cache: Optional[Dict] = None
+    ) -> None:
+        """Adopt donor frontiers for rows still stranded (jumpSlot)."""
+        from .ops.lifecycle import jump_rows
+
+        W = self.cfg.window
+        exec_np = np.asarray(self.state.exec_slot)
+        jumps: List[Dict] = []      # engine jump + app restore
+        app_only: List[Dict] = []   # app restore only (device was current)
+        for ent in states:
+            g, name = int(ent["row"]), ent["name"]
+            if self.names.get(name) != g:
+                continue
+            if int(np.asarray(self.state.version)[g]) != int(ent["version"]):
+                continue
+            donor_exec = int(ent["exec"])
+            my_exec = int(exec_np[g])
+            if donor_exec >= my_exec + W:
+                # only jump clear past my whole ring — anything nearer can
+                # (and must) be learned through the normal gather path, and
+                # the jump may then safely forget my in-window accepted
+                # values (all below the donor frontier, decided, obsolete)
+                jumps.append(ent)
+            elif (
+                donor_exec <= my_exec
+                and donor_exec > int(self.app_exec_slot[g])
+            ):
+                # device is current but the APP cursor stranded behind the
+                # payload-retention horizon: adopt the donor's app state at
+                # its (<= mine) frontier and resume host execution from
+                # there — no engine surgery needed or safe
+                app_only.append(ent)
+        if not jumps and not app_only:
+            return
+        if jumps:
+            self.state = jump_rows(
+                self.state,
+                np.array([e["row"] for e in jumps]),
+                np.array([e["exec"] for e in jumps]),
+                np.array([e["bal"] for e in jumps]),
+                np.array([e["app_hash"] for e in jumps]),
+                np.array([e["n_execd"] for e in jumps]),
+                np.array([e["stopped"] for e in jumps]),
+            )
+        now = time.time()
+        for rid_s, resp in (response_cache or {}).items():
+            self.response_cache.setdefault(int(rid_s), (now, resp))
+        for ent in jumps:
+            g = int(ent["row"])
+            self.app.restore(ent["name"], ent["app_state"])
+            self.app_exec_slot[g] = int(ent["exec"])
+            self.pending_exec.pop(g, None)
+            if int(ent["stopped"]) and self.on_stop_executed is not None:
+                # the STOP decision will never execute locally (the jump
+                # landed past it) — fire the hook now so the epoch layer
+                # captures the final state and acks pending stops
+                try:
+                    self.on_stop_executed(
+                        ent["name"], g, int(ent["version"])
+                    )
+                except Exception:
+                    pass
+        for ent in app_only:
+            g = int(ent["row"])
+            self.app.restore(ent["name"], ent["app_state"])
+            self.app_exec_slot[g] = int(ent["exec"])
+            pend = self.pending_exec.get(g)
+            if pend:  # decisions at/past the adopted cursor still execute
+                for slot in [s for s in pend if s < int(ent["exec"])]:
+                    del pend[slot]
+        # make the adoption durable at the next cadence point (debounced:
+        # several replies in one burst must not each snapshot the engine);
+        # until then a crash merely rewinds to a state the pull re-heals
+        self._slots_since_ckpt = max(self._slots_since_ckpt, self.checkpoint_every)
 
     # ------------------------------------------------------------------
     # checkpointing (consistentCheckpoint analog, :1553-1615)

@@ -105,6 +105,45 @@ def kill_groups(state: EngineState, idx: jnp.ndarray) -> EngineState:
     )
 
 
+def jump_rows(
+    state: EngineState,
+    idx: jnp.ndarray,       # [N] rows to jump
+    exec_slot: jnp.ndarray, # [N] donor's executed frontier
+    bal: jnp.ndarray,       # [N] donor's promised ballot
+    app_hash: jnp.ndarray,  # [N] donor's device hash chain at that frontier
+    n_execd: jnp.ndarray,   # [N]
+    stopped: jnp.ndarray,   # [N]
+) -> EngineState:
+    """Checkpoint-transfer jump (``PaxosAcceptor.jumpSlot``,
+    ``PaxosAcceptor.java:538`` / ``handleCheckpoint``,
+    ``PaxosInstanceStateMachine.java:1744``): a straggler whose needed
+    decisions left every peer's ring adopts a donor's frontier wholesale.
+    All windows clear — everything below the new frontier is decided and
+    obsolete, and the caller guarantees ``exec_slot >= old frontier + W``
+    so no live accepted value of this replica is forgotten."""
+    idx = jnp.asarray(idx, jnp.int32)
+    n = idx.shape[0]
+    W = state.acc_bal.shape[1]
+    nullw = jnp.full((n, W), NULL, jnp.int32)
+    return state._replace(
+        bal=state.bal.at[idx].set(jnp.maximum(state.bal[idx], jnp.asarray(bal, jnp.int32))),
+        exec_slot=state.exec_slot.at[idx].set(jnp.asarray(exec_slot, jnp.int32)),
+        acc_bal=state.acc_bal.at[idx].set(nullw),
+        acc_vid=state.acc_vid.at[idx].set(nullw),
+        acc_slot=state.acc_slot.at[idx].set(nullw),
+        dec_vid=state.dec_vid.at[idx].set(nullw),
+        dec_slot=state.dec_slot.at[idx].set(nullw),
+        app_hash=state.app_hash.at[idx].set(jnp.asarray(app_hash, jnp.int32)),
+        n_execd=state.n_execd.at[idx].set(jnp.asarray(n_execd, jnp.int32)),
+        stopped=state.stopped.at[idx].set(jnp.asarray(stopped, jnp.int32)),
+        c_phase=state.c_phase.at[idx].set(IDLE),
+        c_bal=state.c_bal.at[idx].set(NULL),
+        c_next_slot=state.c_next_slot.at[idx].set(jnp.asarray(exec_slot, jnp.int32)),
+        c_prop_vid=state.c_prop_vid.at[idx].set(nullw),
+        c_prop_slot=state.c_prop_slot.at[idx].set(nullw),
+    )
+
+
 def extract_rows(state: EngineState, idx) -> Tuple:
     """Gather full rows for pause-to-disk (HotRestoreInfo analog)."""
     idx = jnp.asarray(idx, jnp.int32)

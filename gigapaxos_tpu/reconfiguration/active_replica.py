@@ -87,10 +87,9 @@ class ActiveReplica:
         # duplicate of an EARLIER probe must never recreate the group at a
         # stale row after a later probe won
         self._create_attempts: Dict[Tuple[str, int], int] = {}
-        # hook the manager's stop-execution signal
-        mgr = getattr(coordinator, "manager", None)
-        if mgr is not None:
-            mgr.on_stop_executed = self._on_stop_executed
+        # hook the coordinator's stop-execution signal (fires on execution
+        # AND on a checkpoint jump that lands past the stop)
+        coordinator.set_stop_callback(self._on_stop_executed)
 
     # ------------------------------------------------------------------
     # epoch-op handlers (dispatch table)
@@ -138,24 +137,29 @@ class ActiveReplica:
         self._ack_start(body, self._create(body, state))
         return ()
 
-    def _create(self, body: Dict, state: Optional[str]) -> bool:
+    def _create(self, body: Dict, state: Optional[str]) -> str:
+        """Returns "ok", "collision" (row occupied -> RC must probe a new
+        row) or "not-ready" (transient local refusal, e.g. the old epoch's
+        stop hasn't landed here yet -> RC just retransmits, same row)."""
         key = (body["name"], int(body["epoch"]))
         attempt = int(body.get("attempt", 0))
         if attempt < self._create_attempts.get(key, 0):
-            return False  # stale row probe (delayed duplicate): never act
+            return "not-ready"  # stale row probe (delayed duplicate): never act
         self._create_attempts[key] = attempt
         try:
-            return self.coordinator.create_replica_group(
+            ok = self.coordinator.create_replica_group(
                 body["name"], int(body["epoch"]), list(body["actives"]),
                 state, row=int(body["row"]),
             )
+            return "ok" if ok else "not-ready"
         except RuntimeError:
-            return False  # row collision -> NACK; the RC probes another row
+            return "collision"
 
-    def _ack_start(self, body: Dict, ok: bool) -> None:
+    def _ack_start(self, body: Dict, outcome: str) -> None:
         self.send(tuple(body["rc"]), "ack_start_epoch", {
             "name": body["name"], "epoch": body["epoch"],
-            "row": body["row"], "ok": ok, "from": self.my_id,
+            "row": body["row"], "ok": outcome == "ok", "reason": outcome,
+            "from": self.my_id,
         })
 
     # ---- stop (handleStopEpoch, ActiveReplica.java:917) ----------------
@@ -165,8 +169,7 @@ class ActiveReplica:
         if (name, epoch) in self.final_states:
             self._ack_stop(rc, name, epoch)  # already stopped + captured
             return
-        mgr = getattr(self.coordinator, "manager", None)
-        cur_epoch = mgr.current_epoch(name) if mgr is not None else None
+        cur_epoch = self.coordinator.current_epoch(name)
         if cur_epoch is None or cur_epoch > epoch:
             # unknown here (I never created this epoch) or already moved
             # past it: nothing to stop — ack so the task can make progress
@@ -178,7 +181,7 @@ class ActiveReplica:
         self._pending_stop_acks.setdefault((name, epoch), [])
         if rc not in self._pending_stop_acks[(name, epoch)]:
             self._pending_stop_acks[(name, epoch)].append(rc)
-        if mgr is not None and mgr.is_stopped(name):
+        if self.coordinator.is_stopped(name):
             # stop decided on-device (e.g. proposed by a peer) but the local
             # app hasn't executed it yet — the on_stop_executed hook will
             # fire the ack; don't re-propose
@@ -214,10 +217,9 @@ class ActiveReplica:
             # checkpoint of it.  (Old-epoch rows on overlap members can't
             # serve — their app state moved on — but the requester
             # round-robins over all prev actives.)
-            mgr = getattr(self.coordinator, "manager", None)
             if (
-                mgr is None or mgr.current_epoch(name) != epoch
-                or not mgr.is_stopped(name)
+                self.coordinator.current_epoch(name) != epoch
+                or not self.coordinator.is_stopped(name)
             ):
                 return
             state = self.coordinator.app.checkpoint(name)
@@ -231,9 +233,7 @@ class ActiveReplica:
     # ---- drop (handleDropEpochFinalState, :968) ------------------------
     def _handle_drop_epoch(self, body: Dict) -> None:
         name, epoch = body["name"], int(body["epoch"])
-        mgr = getattr(self.coordinator, "manager", None)
-        exists = mgr is not None and mgr.epoch_row(name, epoch) is not None
-        if exists:
+        if self.coordinator.hosts_epoch(name, epoch):
             if not self.coordinator.delete_replica_group(name, epoch):
                 # group present but not yet stopped locally (lagging stop
                 # execution): stay silent, the drop task's retransmit will

@@ -55,6 +55,24 @@ class AbstractReplicaCoordinator:
     def get_replica_group(self, name: str) -> Optional[List[int]]:
         raise NotImplementedError
 
+    # -- epoch introspection (used by ActiveReplica's epoch ops; part of
+    # the SPI so non-paxos coordinators can slot in without ActiveReplica
+    # reaching into implementation internals) -----------------------------
+    def current_epoch(self, name: str) -> Optional[int]:
+        raise NotImplementedError
+
+    def is_stopped(self, name: str) -> bool:
+        raise NotImplementedError
+
+    def hosts_epoch(self, name: str, epoch: int) -> bool:
+        """True if this node still holds (name, epoch) — current or demoted."""
+        raise NotImplementedError
+
+    def set_stop_callback(self, cb) -> None:
+        """Register cb(name, row, epoch), fired when an epoch-final stop
+        executes locally (on every replica)."""
+        raise NotImplementedError
+
 
 class PaxosReplicaCoordinator(AbstractReplicaCoordinator):
     """Names -> engine rows via a :class:`PaxosManager`."""
@@ -96,3 +114,15 @@ class PaxosReplicaCoordinator(AbstractReplicaCoordinator):
 
     def get_replica_group(self, name: str) -> Optional[List[int]]:
         return self.manager.get_replica_group(name)
+
+    def current_epoch(self, name: str) -> Optional[int]:
+        return self.manager.current_epoch(name)
+
+    def is_stopped(self, name: str) -> bool:
+        return self.manager.is_stopped(name)
+
+    def hosts_epoch(self, name: str, epoch: int) -> bool:
+        return self.manager.epoch_row(name, epoch) is not None
+
+    def set_stop_callback(self, cb) -> None:
+        self.manager.on_stop_executed = cb

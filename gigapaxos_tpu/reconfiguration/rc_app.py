@@ -60,6 +60,7 @@ class RCRecordsApp(Replicable):
                 state=RCState.WAIT_ACK_START,
                 actives=[], new_actives=list(op["actives"]),
                 row=-1, new_row=int(op["row"]),
+                initial_state=op.get("initial_state"),
             )
             self.records[name] = rec
             return True

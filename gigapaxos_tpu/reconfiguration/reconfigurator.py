@@ -89,21 +89,54 @@ class StartEpochTask(ProtocolTask):
         if kind != "ack_start_epoch" or int(body["row"]) != self.row:
             return ()
         if not body.get("ok"):
-            # row collision somewhere: probe the next candidate everywhere
-            self.attempt += 1
-            self.acked.clear()
-            return self.start()
+            if body.get("reason") == "collision":
+                # row occupied somewhere: probe the next candidate everywhere
+                self.attempt += 1
+                self.acked.clear()
+                return self.start()
+            # transient refusal ("not-ready": e.g. the old epoch's stop
+            # hasn't landed on that member yet) — same row, just wait for
+            # the periodic retransmit; re-probing would churn rows
+            return ()
         self.acked.add(int(body["from"]))
         if len(self.acked) >= self.majority:
             self.done = True
             # commit COMPLETE (with the row that won) through RC paxos;
-            # prev-epoch info rides along so the applied callback can GC it
+            # prev-epoch info rides along so the applied callback can GC
+            # it, and the ack set so laggards get a late-start retransmit
             self.rcf.propose_op({
                 "op": COMPLETE, "name": self.op["name"], "row": self.row,
+                "attempt": self.attempt,
+                "acked": sorted(self.acked),
                 "prev_actives": self.op.get("prev_actives") or [],
                 "prev_epoch": self.op.get("prev_epoch", -1),
             })
         return ()
+
+
+class LateStartTask(ThresholdProtocolTask):
+    """Post-COMPLETE retransmit of start_epoch to members that had not yet
+    acked when the majority was reached — without it those members never
+    learn the epoch and the group runs under-replicated until a
+    missed-birth discovery finds them."""
+
+    restart_period_s = 2.0
+    max_lifetime_s = 120.0
+
+    def __init__(self, key: str, rcf: "Reconfigurator", body: Dict,
+                 laggards: List[int]):
+        super().__init__(key, laggards, threshold=len(laggards))
+        self.rcf = rcf
+        self.body = body  # the winning start_epoch body (final row/attempt)
+
+    def send_to(self, node):
+        return (("AR", node), "start_epoch", self.body)
+
+    def is_ack(self, kind, body):
+        if kind == "ack_start_epoch" and body.get("ok") \
+                and int(body["row"]) == int(self.body["row"]):
+            return int(body["from"])
+        return None
 
 
 class StopEpochTask(ThresholdProtocolTask):
@@ -205,6 +238,7 @@ class Reconfigurator:
         self.tasks = ProtocolExecutor(send=lambda m: self.send(m[0], m[1], m[2]))
         # client replies owed on COMPLETE / DELETE_FINAL: name -> client addr
         self._pending_clients: Dict[str, Any] = {}
+        self._tick_count = 0
         rc_app.on_applied = self._on_applied
 
     # ------------------------------------------------------------------
@@ -232,7 +266,10 @@ class Reconfigurator:
             self._handle_request_actives(body)
         elif kind in ("ack_start_epoch",):
             name = body["name"]
-            self.tasks.handle_event(f"start:{name}", kind, body)
+            if not self.tasks.handle_event(f"start:{name}", kind, body):
+                self.tasks.handle_event(
+                    f"latestart:{name}:{body.get('epoch')}", kind, body
+                )
         elif kind in ("ack_stop_epoch",):
             self.tasks.handle_event(f"stop:{body['name']}", kind, body)
         elif kind in ("ack_drop_epoch",):
@@ -240,6 +277,9 @@ class Reconfigurator:
 
     def tick(self, now: Optional[float] = None) -> None:
         self.tasks.tick(now)
+        self._tick_count += 1
+        if self._tick_count % self.REDRIVE_EVERY == 0:
+            self._redrive_records()
 
     # ---- create (handleCreateServiceName, Reconfigurator.java:484) -----
     def _handle_create(self, body: Dict) -> None:
@@ -328,6 +368,65 @@ class Reconfigurator:
             self.send(tuple(client), kind, {"name": name, **fields})
 
     # ------------------------------------------------------------------
+    # record re-drive: an expired task (long partition) must not strand a
+    # record mid-transition — the owner periodically respawns the pending
+    # step (CommitWorker re-propose + WaitPrimaryExecution retry analog)
+    # ------------------------------------------------------------------
+    REDRIVE_EVERY = 32  # tick() calls between record scans
+
+    def _redrive_records(self) -> None:
+        for name, rec in list(self.rc_app.records.items()):
+            if rec.deleted or not self.is_primary(name):
+                continue
+            if rec.state is RCState.WAIT_ACK_STOP:
+                self.tasks.spawn_if_not_running(
+                    f"stop:{name}",
+                    lambda n=name, r=rec: StopEpochTask(
+                        f"stop:{n}", self, n, r.epoch, r.actives,
+                        on_stopped=lambda: self.propose_op(
+                            {"op": STOP_DONE, "name": n}
+                        ),
+                    ),
+                )
+            elif rec.state is RCState.WAIT_ACK_START:
+                if rec.actives:  # reconfiguration e -> e+1
+                    op = {"name": name, "epoch": rec.epoch + 1,
+                          "actives": rec.new_actives,
+                          "prev_actives": rec.actives,
+                          "prev_epoch": rec.epoch}
+                else:            # initial create
+                    op = {"name": name, "epoch": rec.epoch,
+                          "actives": rec.new_actives,
+                          "initial_state": rec.initial_state}
+                self.tasks.spawn_if_not_running(
+                    f"start:{name}",
+                    lambda k=f"start:{name}", o=op: StartEpochTask(k, self, o),
+                )
+            elif rec.state is RCState.WAIT_DELETE:
+                if self.tasks.is_running(f"stop:{name}") or \
+                        self.tasks.is_running(f"drop:{name}"):
+                    continue
+                epoch, actives = rec.epoch, list(rec.actives)
+
+                def after_drop(n=name):
+                    self.propose_op({"op": DELETE_FINAL, "name": n})
+
+                def after_stop(n=name, e=epoch, a=actives):
+                    self.tasks.spawn_if_not_running(
+                        f"drop:{n}",
+                        lambda: DropEpochTask(
+                            f"drop:{n}", self, n, e, a, on_done=after_drop
+                        ),
+                    )
+
+                self.tasks.spawn_if_not_running(
+                    f"stop:{name}",
+                    lambda n=name, e=epoch, a=actives: StopEpochTask(
+                        f"stop:{n}", self, n, e, a, on_stopped=after_stop
+                    ),
+                )
+
+    # ------------------------------------------------------------------
     # RC-record commit callbacks (CommitWorker execution path)
     # ------------------------------------------------------------------
     def _on_applied(self, op: Dict) -> None:
@@ -378,6 +477,21 @@ class Reconfigurator:
                           "create_ack" if was_create else "reconfigure_ack",
                           {"name": name, "ok": True, "actives": rec.actives,
                            "epoch": rec.epoch})
+            laggards = [a for a in rec.actives
+                        if a not in (op.get("acked") or rec.actives)]
+            if laggards:
+                key = f"latestart:{name}:{rec.epoch}"
+                body = {
+                    "name": name, "epoch": rec.epoch, "actives": rec.actives,
+                    "row": rec.row, "attempt": int(op.get("attempt", 0)),
+                    "initial_state": rec.initial_state if was_create else None,
+                    "prev_actives": op.get("prev_actives") or [],
+                    "prev_epoch": int(op.get("prev_epoch", -1)),
+                    "rc": ["RC", self.my_id],
+                }
+                self.tasks.spawn_if_not_running(
+                    key, lambda: LateStartTask(key, self, body, laggards)
+                )
             if not was_create:
                 # GC the previous epoch on its old actives
                 prev_actives = list(op.get("prev_actives") or [])

@@ -39,12 +39,16 @@ class ReconfigurationRecord:
     row: int = -1        # engine row of the current epoch's group (creator-chosen)
     new_row: int = -1    # engine row for the pending epoch
     deleted: bool = False
+    # creation-time initial app state, kept so an expired/re-driven start
+    # task can rebuild the StartEpoch without the original client request
+    initial_state: Optional[str] = None
 
     def to_json(self) -> Dict[str, Any]:
         return {
             "name": self.name, "epoch": self.epoch, "state": self.state.value,
             "actives": self.actives, "new_actives": self.new_actives,
             "row": self.row, "new_row": self.new_row, "deleted": self.deleted,
+            "initial_state": self.initial_state,
         }
 
     @classmethod
@@ -54,6 +58,7 @@ class ReconfigurationRecord:
             actives=list(d["actives"]), new_actives=list(d["new_actives"]),
             row=int(d.get("row", -1)), new_row=int(d.get("new_row", -1)),
             deleted=bool(d.get("deleted", False)),
+            initial_state=d.get("initial_state"),
         )
 
     # ---- transitions (setState analog, ReconfigurationRecord.java:466+) --

@@ -204,6 +204,36 @@ def test_delete_completes_with_dead_active(monkeypatch):
         c.close()
 
 
+def test_laggard_active_gets_late_start(monkeypatch):
+    """An active whose start_epoch was lost while the majority completed
+    the create must still be brought into the epoch afterwards
+    (LateStartTask), not left permanently under-replicated."""
+    from gigapaxos_tpu.reconfiguration import reconfigurator as rc_mod
+
+    monkeypatch.setattr(rc_mod.LateStartTask, "restart_period_s", 0.02)
+    ar_cfg = EngineConfig(n_groups=16, window=8, req_lanes=4, n_replicas=3)
+    rc_cfg = EngineConfig(n_groups=8, window=8, req_lanes=4, n_replicas=3)
+    c = ReconfigurableCluster(ar_cfg, rc_cfg, HashChainApp)
+    try:
+        c.msg_filter = (
+            lambda dst, kind, body: not (dst == ("AR", 2) and kind == "start_epoch")
+        )
+        c.client_request("create_service", {"name": "lag", "actives": [0, 1, 2]})
+        ack = c.wait_for("create_ack", max_steps=120)
+        assert ack and ack["ok"]
+        assert c.ars.managers[2].names.get("lag") is None  # missed the birth
+        c.msg_filter = None  # network heals
+        for _ in range(60):
+            if c.ars.managers[2].names.get("lag") is not None:
+                break
+            c.step()
+        assert c.ars.managers[2].names.get("lag") is not None, \
+            "laggard never received the late start_epoch"
+        _run_requests(c, "lag", ["p", "q"], entry=2)  # fully participating
+    finally:
+        c.close()
+
+
 def test_migration_survives_lossy_control_plane(monkeypatch):
     """Drop 30% of reconfiguration-plane messages: the WaitAck* tasks'
     retransmits must still drive the epoch change to completion (the
