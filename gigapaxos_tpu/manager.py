@@ -118,6 +118,13 @@ class PaxosManager:
         # host-side tables
         self.names: Dict[str, int] = {}        # service name -> CURRENT epoch row
         self.row_name: Dict[int, str] = {}     # occupancy: row -> name (or name@vE)
+        # rows created by a start-epoch whose COMPLETE hasn't been confirmed
+        # yet: proposals are accepted and QUEUED but never admitted to
+        # consensus (build_requests skips pending rows), so nothing can
+        # commit on a row the reconfigurator's probe may still move — the
+        # recreate in _create_locked is only safe because of this gate, and
+        # the held queue follows the name to the new row
+        self.pending_rows: set = set()
         # stopped prior epochs kept until the reconfigurator drops them
         # (epoch final state may still be fetched from their app snapshot)
         self.old_epochs: Dict[Tuple[str, int], int] = {}  # (name, epoch) -> row
@@ -222,6 +229,9 @@ class PaxosManager:
         self.row_name = {v: k for k, v in self.names.items()}
         for (nm, e), r in self.old_epochs.items():
             self.row_name[r] = nm
+        self.pending_rows = {
+            int(r) for r in rec.pending_rows if r in live_rows
+        }
         self._next_counter = int(meta.get("next_counter", 1))
         for vid in rec.payloads:
             base = vid & ~STOP_BIT
@@ -285,13 +295,19 @@ class PaxosManager:
         initial_state: Optional[str] = None,
         version: int = 0,
         row: Optional[int] = None,
+        pending: bool = False,
     ) -> bool:
         with self._state_lock:
             return self._create_locked(
-                name, members, initial_state, version, row
+                name, members, initial_state, version, row, pending
             )
 
-    def _create_locked(self, name, members, initial_state, version, row) -> bool:
+    def _create_locked(
+        self, name, members, initial_state, version, row, pending=False
+    ) -> bool:
+        # requests held behind the pending gate on a row the probe moved:
+        # they follow the name to its new row (vids/payloads stay live)
+        held_vids: List[int] = []
         if name in self.names:
             cur_row = self.names[name]
             cur_ver = int(np.asarray(self.state.version)[cur_row])
@@ -299,11 +315,24 @@ class PaxosManager:
                 return False
             if version == cur_ver:
                 if row is None or int(row) == cur_row:
-                    return True  # idempotent re-create (start-epoch retransmit)
-                # Same-epoch row change: the reconfigurator's row probe moved
-                # to a fresh row after a collision NACK from some member.
-                # Safe pre-COMPLETE: clients can't know the group yet, so the
-                # short-lived first row has executed nothing; recreate.
+                    # idempotent re-create (start-epoch retransmit); a
+                    # committed retransmit (late-start) confirms the row
+                    if not pending and cur_row in self.pending_rows:
+                        self._unpend_locked(cur_row)
+                    return True
+                # Same-epoch row change: the reconfigurator's row probe
+                # moved to a fresh row after a collision NACK from some
+                # member.  Only safe while the row is still PENDING (the
+                # admission gate guarantees nothing committed here); a
+                # confirmed (unpended) or executed row must refuse as a
+                # collision so the RC's probe converges back to this row.
+                if cur_row not in self.pending_rows or \
+                        int(np.asarray(self.state.n_execd)[cur_row]):
+                    raise RuntimeError(
+                        f"row move for {name!r} v{version} refused: row "
+                        f"{cur_row} is confirmed or already executed"
+                    )
+                held_vids = list(self.queues.get(cur_row, []))
                 self._kill_locked(name)
             else:
                 # Epoch upgrade (reconfiguration): the stopped prior epoch's
@@ -332,6 +361,8 @@ class PaxosManager:
             )
         self.names[name] = row
         self.row_name[row] = name
+        if pending:
+            self.pending_rows.add(row)
         mask = 0
         for m in members:
             mask |= 1 << m
@@ -343,15 +374,38 @@ class PaxosManager:
         self.app_exec_slot[row] = 0
         self.queues.pop(row, None)
         self.pending_exec.pop(row, None)
+        if held_vids:
+            self.queues[row] = held_vids
         if self.logger:
             self.logger.log_create(
                 np.array([row]), np.array([mask]),
                 np.array([version]), np.array([coord0]),
-                names=[name], inits=[initial_state],
+                names=[name], inits=[initial_state], pendings=[pending],
             )
         if self.my_id in members:
             self.app.restore(name, initial_state)
         return True
+
+    def commit_row(self, name: str, epoch: int, row: Optional[int] = None) -> None:
+        """The reconfigurator's COMPLETE confirmed (name, epoch) at `row`:
+        clear the admission gate (durably).  The row check matters: a
+        laggard still holding a LOSING row for this epoch must not un-pend
+        it — that row may alias another group on its peers; the committed
+        late-start recreates it at the winning row instead."""
+        with self._state_lock:
+            cur = self.names.get(name)
+            if cur is None or cur not in self.pending_rows:
+                return
+            if int(np.asarray(self.state.version)[cur]) != int(epoch):
+                return
+            if row is not None and int(row) >= 0 and int(row) != cur:
+                return
+            self._unpend_locked(cur)
+
+    def _unpend_locked(self, row: int) -> None:
+        self.pending_rows.discard(row)
+        if self.logger:
+            self.logger.log_unpend(np.array([row]))
 
     def kill(self, name: str) -> bool:
         with self._state_lock:
@@ -362,6 +416,7 @@ class PaxosManager:
         if row is None:
             return False
         del self.row_name[row]
+        self.pending_rows.discard(row)
         self.state = kill_groups(self.state, np.array([row]))
         if self.logger:
             self.logger.log_kill(np.array([row]))
@@ -387,6 +442,7 @@ class PaxosManager:
                     return False  # never kill a live, unstopped group
                 return self._kill_locked(name)
             del self.row_name[row]
+            self.pending_rows.discard(row)
             self.state = kill_groups(self.state, np.array([row]))
             if self.logger:
                 self.logger.log_kill(np.array([row]))
@@ -551,6 +607,11 @@ class PaxosManager:
         bal = np.asarray(self.state.bal)
         for row, vids in list(self.queues.items()):
             if not vids:
+                continue
+            if row in self.pending_rows:
+                # pre-COMPLETE epoch: hold (don't admit, don't forward) —
+                # nothing may commit on a row the reconfigurator's probe
+                # may still move; the queue drains once epoch_commit lands
                 continue
             coord = int(ballot_coord(int(bal[row])))
             if coord != self.my_id:
@@ -1008,6 +1069,7 @@ class PaxosManager:
         # execution exactly where the app state string left off.
         self.logger.checkpoint(arrays, app_states, {
             "names": self.names,
+            "pending_rows": sorted(self.pending_rows),
             "old_epochs": [[n, e, r] for (n, e), r in self.old_epochs.items()],
             "next_counter": self._next_counter,
             "arena": self.arena,

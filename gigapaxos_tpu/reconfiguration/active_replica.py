@@ -16,8 +16,8 @@ analog), retransmitting round-robin over the previous epoch's actives.
 
 from __future__ import annotations
 
+import hashlib
 import json
-import zlib
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..protocoltask import ProtocolExecutor, ProtocolTask
@@ -28,8 +28,17 @@ Addr = Tuple[str, int]  # ("AR"|"RC", node id)
 
 def stop_request_id(name: str, epoch: int) -> int:
     """Deterministic id for the epoch-final stop request: every active may
-    propose it, the response cache dedupes execution to exactly once."""
-    return zlib.crc32(f"__stop__:{name}:{epoch}".encode()) | (1 << 40)
+    propose it, the response cache dedupes execution to exactly once.
+    64-bit keyed hash with a reserved high bit — the id lives in the
+    manager-global request-id namespace, where a 32-bit hash would hit
+    birthday collisions at the ~1M-group design scale (a cross-name
+    collision answers one name's stop from another's cached response)."""
+    h = int.from_bytes(
+        hashlib.blake2b(
+            f"__stop__:{name}:{epoch}".encode(), digest_size=8
+        ).digest(), "big",
+    )
+    return (1 << 62) | (h & ((1 << 62) - 1))
 
 
 class WaitEpochFinalState(ProtocolTask):
@@ -83,10 +92,6 @@ class ActiveReplica:
         self.final_states: Dict[Tuple[str, int], Optional[str]] = {}
         # stop acks owed once the local stop executes: (name, epoch) -> [rc]
         self._pending_stop_acks: Dict[Tuple[str, int], List[Addr]] = {}
-        # highest row-probe attempt seen per (name, epoch): a delayed
-        # duplicate of an EARLIER probe must never recreate the group at a
-        # stale row after a later probe won
-        self._create_attempts: Dict[Tuple[str, int], int] = {}
         # hook the coordinator's stop-execution signal (fires on execution
         # AND on a checkpoint jump that lands past the stop)
         coordinator.set_stop_callback(self._on_stop_executed)
@@ -107,6 +112,8 @@ class ActiveReplica:
             self.tasks.handle_event(
                 f"wefs:{body['name']}:{body['epoch']}", kind, body
             )
+        elif kind == "epoch_commit":
+            self._handle_epoch_commit(body)
 
     def tick(self, now: Optional[float] = None) -> None:
         self.tasks.tick(now)
@@ -140,16 +147,25 @@ class ActiveReplica:
     def _create(self, body: Dict, state: Optional[str]) -> str:
         """Returns "ok", "collision" (row occupied -> RC must probe a new
         row) or "not-ready" (transient local refusal, e.g. the old epoch's
-        stop hasn't landed here yet -> RC just retransmits, same row)."""
-        key = (body["name"], int(body["epoch"]))
-        attempt = int(body.get("attempt", 0))
-        if attempt < self._create_attempts.get(key, 0):
-            return "not-ready"  # stale row probe (delayed duplicate): never act
-        self._create_attempts[key] = attempt
+        stop hasn't landed here yet -> RC just retransmits, same row).
+
+        No attempt-staleness guard here: the manager's rules make delayed
+        duplicate probes safe — a pending, never-executed row may be
+        recreated at a new row (the live probe's retransmit wins the last
+        word), while a confirmed or executed row refuses the move as a
+        collision.  An attempt-number guard would instead livelock a
+        restarted RC whose re-driven probe resumes below the recorded
+        attempt."""
         try:
+            # a start_epoch creates the group PENDING (proposals queue but
+            # are not admitted to consensus)
+            # until the RC's COMPLETE confirms the row via epoch_commit;
+            # a late-start retransmit carries committed=True and creates
+            # (or confirms) the group live
             ok = self.coordinator.create_replica_group(
                 body["name"], int(body["epoch"]), list(body["actives"]),
                 state, row=int(body["row"]),
+                pending=not body.get("committed", False),
             )
             return "ok" if ok else "not-ready"
         except RuntimeError:
@@ -162,9 +178,22 @@ class ActiveReplica:
             "from": self.my_id,
         })
 
+    # ---- commit (the RC's COMPLETE confirmation of the row) ------------
+    def _handle_epoch_commit(self, body: Dict) -> None:
+        name, epoch = body["name"], int(body["epoch"])
+        self.coordinator.commit_replica_group(name, epoch, body.get("row"))
+        self.send(tuple(body["rc"]), "ack_epoch_commit", {
+            "name": name, "epoch": epoch, "from": self.my_id,
+        })
+
     # ---- stop (handleStopEpoch, ActiveReplica.java:917) ----------------
     def _handle_stop_epoch(self, body: Dict) -> None:
         name, epoch = body["name"], int(body["epoch"])
+        # a stop for epoch e implies the record reached READY at e (the RC
+        # only reconfigures/deletes READY records) — a lost epoch_commit
+        # must not wedge the stop proposal behind the admission gate (the
+        # row rides along so a stale losing row is never un-pended)
+        self.coordinator.commit_replica_group(name, epoch, body.get("row"))
         rc = tuple(body["rc"])
         if (name, epoch) in self.final_states:
             self._ack_stop(rc, name, epoch)  # already stopped + captured
@@ -240,7 +269,6 @@ class ActiveReplica:
                 # find us once the stop lands — never kill a live group
                 return
         self.final_states.pop((name, epoch), None)
-        self._create_attempts.pop((name, epoch), None)
         self.send(tuple(body["rc"]), "ack_drop_epoch", {
             "name": name, "epoch": epoch, "from": self.my_id,
         })

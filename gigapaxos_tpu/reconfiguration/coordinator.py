@@ -46,7 +46,16 @@ class AbstractReplicaCoordinator:
         members: List[int],
         initial_state: Optional[str],
         row: Optional[int] = None,
+        pending: bool = False,
     ) -> bool:
+        raise NotImplementedError
+
+    def commit_replica_group(
+        self, name: str, epoch: int, row: Optional[int] = None
+    ) -> None:
+        """The RC's COMPLETE confirmed this epoch's placement at `row`:
+        lift the pre-COMPLETE admission gate (no-op for non-pending groups
+        or a mismatched — losing — row)."""
         raise NotImplementedError
 
     def delete_replica_group(self, name: str, epoch: int) -> bool:
@@ -104,10 +113,17 @@ class PaxosReplicaCoordinator(AbstractReplicaCoordinator):
         members: List[int],
         initial_state: Optional[str],
         row: Optional[int] = None,
+        pending: bool = False,
     ) -> bool:
         return self.manager.create_paxos_instance(
-            name, members, initial_state=initial_state, version=epoch, row=row
+            name, members, initial_state=initial_state, version=epoch,
+            row=row, pending=pending,
         )
+
+    def commit_replica_group(
+        self, name: str, epoch: int, row: Optional[int] = None
+    ) -> None:
+        self.manager.commit_row(name, epoch, row=row)
 
     def delete_replica_group(self, name: str, epoch: int) -> bool:
         return self.manager.kill_epoch(name, epoch)

@@ -29,6 +29,7 @@ STOP_DONE = "stop_done"              # old epoch stopped: -> WAIT_ACK_START
 COMPLETE = "complete"                # majority of new actives up: -> READY
 DELETE_INTENT = "delete_intent"      # -> WAIT_DELETE
 DELETE_FINAL = "delete_final"        # purge record
+DROP_DONE = "drop_done"              # previous epoch's drop round finished
 
 
 class RCRecordsApp(Replicable):
@@ -78,6 +79,11 @@ class RCRecordsApp(Replicable):
             if "row" in op:
                 rec.new_row = int(op["row"])
             return rec.complete()
+        if kind == DROP_DONE:
+            pde = rec.pending_drop_epoch
+            if pde is None or int(op.get("epoch", -1)) != pde:
+                return False  # stale/duplicate drop confirmation
+            return rec.drop_done()
         if kind == DELETE_INTENT:
             return rec.start_delete()
         if kind == DELETE_FINAL:

@@ -32,6 +32,7 @@ from .rc_app import (
     CREATE_INTENT,
     DELETE_FINAL,
     DELETE_INTENT,
+    DROP_DONE,
     RECONFIGURE_INTENT,
     STOP_DONE,
     RCRecordsApp,
@@ -92,6 +93,9 @@ class StartEpochTask(ProtocolTask):
             if body.get("reason") == "collision":
                 # row occupied somewhere: probe the next candidate everywhere
                 self.attempt += 1
+                # remember the probe position so an expired task's re-drive
+                # resumes here instead of restarting at attempt 0
+                self.rcf._last_attempt[self.op["name"]] = self.attempt
                 self.acked.clear()
                 return self.start()
             # transient refusal ("not-ready": e.g. the old epoch's stop
@@ -118,16 +122,21 @@ class LateStartTask(ThresholdProtocolTask):
     """Post-COMPLETE retransmit of start_epoch to members that had not yet
     acked when the majority was reached — without it those members never
     learn the epoch and the group runs under-replicated until a
-    missed-birth discovery finds them."""
+    missed-birth discovery finds them.  ``on_finished`` fires exactly once
+    when every laggard acked OR the task expires — the previous epoch's
+    drop is chained off it so a laggard's final-state fetch still finds
+    donors (dropping concurrently would purge them)."""
 
     restart_period_s = 2.0
     max_lifetime_s = 120.0
 
     def __init__(self, key: str, rcf: "Reconfigurator", body: Dict,
-                 laggards: List[int]):
+                 laggards: List[int],
+                 on_finished: Optional[Callable[[], None]] = None):
         super().__init__(key, laggards, threshold=len(laggards))
         self.rcf = rcf
         self.body = body  # the winning start_epoch body (final row/attempt)
+        self._on_finished = on_finished
 
     def send_to(self, node):
         return (("AR", node), "start_epoch", self.body)
@@ -138,6 +147,59 @@ class LateStartTask(ThresholdProtocolTask):
             return int(body["from"])
         return None
 
+    def on_threshold(self):
+        self._finish()
+        return ()
+
+    def on_expire(self):
+        self._finish()
+
+    def _finish(self):
+        cb, self._on_finished = self._on_finished, None
+        if cb is not None:
+            cb()
+
+
+class EpochCommitTask(ThresholdProtocolTask):
+    """Post-COMPLETE confirmation of the winning row to EVERY new active:
+    lifts the pre-COMPLETE admission gate (manager ``pending_rows``).  All
+    members must confirm — a member stuck pending holds every proposal it
+    receives (fatal for the whole group if that member is the ballot
+    coordinator) — so an unconfirmed round is re-driven from the record
+    scan until every active acks (``_redrive_records``; a fresh RC also
+    re-drives rounds for READY records it can't prove confirmed, covering
+    the restart-after-COMPLETE case)."""
+
+    restart_period_s = 2.0
+    max_lifetime_s = 120.0
+
+    def __init__(self, key: str, rcf: "Reconfigurator", name: str,
+                 epoch: int, actives: List[int], row: int):
+        super().__init__(key, actives, threshold=len(actives))
+        self.rcf = rcf
+        self.name = name
+        self.epoch = epoch
+        self.row = row
+
+    def send_to(self, node):
+        # the winning row rides along: a laggard still holding a LOSING
+        # row for this epoch must NOT un-pend it (the losing row may alias
+        # another group on its peers) — it waits for the late-start
+        return (("AR", node), "epoch_commit", {
+            "name": self.name, "epoch": self.epoch, "row": self.row,
+            "rc": ["RC", self.rcf.my_id],
+        })
+
+    def is_ack(self, kind, body):
+        if kind == "ack_epoch_commit" and body["name"] == self.name \
+                and int(body["epoch"]) == self.epoch:
+            return int(body["from"])
+        return None
+
+    def on_threshold(self):
+        self.rcf._commit_done.add((self.name, self.epoch))
+        return ()
+
 
 class StopEpochTask(ThresholdProtocolTask):
     """WaitAckStopEpoch analog: majority-stop the old epoch."""
@@ -147,16 +209,17 @@ class StopEpochTask(ThresholdProtocolTask):
 
     def __init__(self, key: str, rcf: "Reconfigurator", name: str,
                  epoch: int, actives: List[int],
-                 on_stopped: Callable[[], None]):
+                 on_stopped: Callable[[], None], row: int = -1):
         super().__init__(key, actives)  # majority threshold default
         self.rcf = rcf
         self.name = name
         self.epoch = epoch
+        self.row = row
         self._on_stopped = on_stopped
 
     def send_to(self, node):
         return (("AR", node), "stop_epoch", {
-            "name": self.name, "epoch": self.epoch,
+            "name": self.name, "epoch": self.epoch, "row": self.row,
             "rc": ["RC", self.rcf.my_id],
         })
 
@@ -172,20 +235,27 @@ class StopEpochTask(ThresholdProtocolTask):
 
 
 class DropEpochTask(ThresholdProtocolTask):
-    """WaitAckDropEpoch analog: GC the old epoch everywhere (best effort —
-    expiry just leaves stragglers' rows to a later drop/cleanup)."""
+    """WaitAckDropEpoch analog: GC the old epoch everywhere.
+
+    Two completion policies: the DELETE chain sets
+    ``fire_done_on_expire=True`` so a dead active can't wedge DELETE_FINAL
+    (stragglers go to the in-memory re-drop list); the reconfiguration
+    prev-epoch drop sets it False — its re-drive is record-level
+    (``pending_drop_epoch``, paxos-replicated) and survives RC restarts."""
 
     restart_period_s = 2.0
     max_lifetime_s = 60.0
 
     def __init__(self, key: str, rcf: "Reconfigurator", name: str,
                  epoch: int, actives: List[int],
-                 on_done: Optional[Callable[[], None]] = None):
+                 on_done: Optional[Callable[[], None]] = None,
+                 fire_done_on_expire: bool = True):
         super().__init__(key, actives, threshold=len(actives))
         self.rcf = rcf
         self.name = name
         self.epoch = epoch
         self._on_done = on_done
+        self._fire_on_expire = fire_done_on_expire
 
     def send_to(self, node):
         return (("AR", node), "drop_epoch", {
@@ -204,11 +274,18 @@ class DropEpochTask(ThresholdProtocolTask):
         return ()
 
     def on_expire(self):
+        if not self._fire_on_expire:
+            return  # record-level re-drive respawns this drop
         # Best-effort GC: a dead active must not wedge the chain forever
-        # (the delete path gates DELETE_FINAL on this).  Stragglers' rows
-        # are reclaimed when they next hear a drop or are replaced — the
-        # reference's MAX_FINAL_STATE_AGE age-out plays the same role.
+        # (the delete path gates DELETE_FINAL on this).  Stragglers are
+        # remembered and re-dropped periodically once they resurface
+        # (MAX_FINAL_STATE_AGE re-drop analog, Reconfigurator.java:747) —
+        # without that a 60s-partitioned active would leak the stopped row
+        # until process death.
         self._fire_done()
+        stragglers = [n for n in self.nodes if n not in self.acked]
+        if stragglers:
+            self.rcf.note_unfinished_drop(self.name, self.epoch, stragglers)
 
     def _fire_done(self):
         cb, self._on_done = self._on_done, None
@@ -238,6 +315,17 @@ class Reconfigurator:
         self.tasks = ProtocolExecutor(send=lambda m: self.send(m[0], m[1], m[2]))
         # client replies owed on COMPLETE / DELETE_FINAL: name -> client addr
         self._pending_clients: Dict[str, Any] = {}
+        # epochs whose drop expired with unreached stragglers: re-dropped
+        # periodically so a long-partitioned active doesn't leak the row
+        # forever (MAX_FINAL_STATE_AGE re-drop analog)
+        self._unfinished_drops: Dict[Tuple[str, int], List[int]] = {}
+        # epochs whose commit round every active confirmed; READY records
+        # not in here get the round re-driven (in-memory: a restarted RC
+        # re-confirms each READY record once — idempotent at the ARs)
+        self._commit_done: set = set()
+        # last row-probe attempt per name: an expired start task's re-drive
+        # resumes probing here instead of restarting at attempt 0
+        self._last_attempt: Dict[str, int] = {}
         self._tick_count = 0
         rc_app.on_applied = self._on_applied
 
@@ -265,21 +353,68 @@ class Reconfigurator:
         elif kind == "request_actives":
             self._handle_request_actives(body)
         elif kind in ("ack_start_epoch",):
-            name = body["name"]
-            if not self.tasks.handle_event(f"start:{name}", kind, body):
+            # start tasks are keyed by (name, epoch) so an old epoch's
+            # late-start ack isn't swallowed by a newer epoch's start task
+            name, epoch = body["name"], body.get("epoch")
+            if not self.tasks.handle_event(f"start:{name}:{epoch}", kind, body):
                 self.tasks.handle_event(
-                    f"latestart:{name}:{body.get('epoch')}", kind, body
+                    f"latestart:{name}:{epoch}", kind, body
                 )
         elif kind in ("ack_stop_epoch",):
             self.tasks.handle_event(f"stop:{body['name']}", kind, body)
         elif kind in ("ack_drop_epoch",):
-            self.tasks.handle_event(f"drop:{body['name']}", kind, body)
+            # drop tasks are keyed by (name, epoch): an ack for an older
+            # epoch's redrop must not be swallowed by a newer epoch's task
+            dkey = f"drop:{body['name']}:{body.get('epoch')}"
+            if not self.tasks.handle_event(dkey, kind, body):
+                self.tasks.handle_event(
+                    f"redrop:{body['name']}:{body.get('epoch')}", kind, body
+                )
+        elif kind in ("ack_epoch_commit",):
+            self.tasks.handle_event(
+                f"commit:{body['name']}:{body.get('epoch')}", kind, body
+            )
 
     def tick(self, now: Optional[float] = None) -> None:
         self.tasks.tick(now)
         self._tick_count += 1
         if self._tick_count % self.REDRIVE_EVERY == 0:
             self._redrive_records()
+            self._redrive_unfinished_drops()
+
+    MAX_REDROPS = 8  # retry budget for post-delete straggler drops
+
+    def note_unfinished_drop(
+        self, name: str, epoch: int, stragglers: List[int]
+    ) -> None:
+        prev = self._unfinished_drops.get((name, epoch))
+        self._unfinished_drops[(name, epoch)] = (
+            list(stragglers), prev[1] if prev else 0
+        )
+
+    def _redrive_unfinished_drops(self) -> None:
+        for (name, epoch), (nodes, att) in list(self._unfinished_drops.items()):
+            key = f"redrop:{name}:{epoch}"
+            if self.tasks.is_running(key):
+                continue
+            if att >= self.MAX_REDROPS:
+                # age out (MAX_FINAL_STATE_AGE analog): a permanently
+                # removed active must not accumulate retransmit work
+                # forever — its rows are reclaimed wholesale if/when it
+                # ever rejoins
+                self._unfinished_drops.pop((name, epoch), None)
+                continue
+            self._unfinished_drops[(name, epoch)] = (list(nodes), att + 1)
+            self.tasks.spawn_if_not_running(
+                key,
+                lambda k=key, n=name, e=epoch, nd=list(nodes): DropEpochTask(
+                    k, self, n, e, nd,
+                    on_done=lambda n=n, e=e: self._unfinished_drops.pop(
+                        (n, e), None
+                    ),
+                    fire_done_on_expire=False,
+                ),
+            )
 
     # ---- create (handleCreateServiceName, Reconfigurator.java:484) -----
     def _handle_create(self, body: Dict) -> None:
@@ -349,6 +484,17 @@ class Reconfigurator:
         if rec is None or rec.deleted:
             self._reply(body, "delete_ack", name, ok=False, reason="unknown")
             return
+        if rec.state is RCState.WAIT_DELETE:
+            # same delete already in flight: a retransmit re-registers for
+            # the eventual DELETE_FINAL reply instead of a false failure
+            if body.get("client") is not None:
+                self._pending_clients[name] = body["client"]
+            return
+        if rec.state is not RCState.READY:
+            # mid-reconfiguration: DELETE_INTENT would be refused by the
+            # record RSM and the client would never hear back — reply now
+            self._reply(body, "delete_ack", name, ok=False, reason="not-ready")
+            return
         if body.get("client") is not None:
             self._pending_clients[name] = body["client"]
         self.propose_op({"op": DELETE_INTENT, "name": name})
@@ -378,7 +524,35 @@ class Reconfigurator:
         for name, rec in list(self.rc_app.records.items()):
             if rec.deleted or not self.is_primary(name):
                 continue
-            if rec.state is RCState.WAIT_ACK_STOP:
+            if rec.state is RCState.READY:
+                if (name, rec.epoch) not in self._commit_done:
+                    ckey = f"commit:{name}:{rec.epoch}"
+                    self.tasks.spawn_if_not_running(
+                        ckey,
+                        lambda k=ckey, n=name, r=rec: EpochCommitTask(
+                            k, self, n, r.epoch, r.actives, r.row
+                        ),
+                    )
+                if rec.pending_drop_epoch is not None and \
+                        not self.tasks.is_running(
+                            f"latestart:{name}:{rec.epoch}"):
+                    # previous epoch's GC owed (survives RC restarts via
+                    # the record); deferred while a late-start still needs
+                    # its final-state donors
+                    pde = int(rec.pending_drop_epoch)
+                    dkey = f"drop:{name}:{pde}"
+                    self.tasks.spawn_if_not_running(
+                        dkey,
+                        lambda k=dkey, n=name, e=pde,
+                        a=list(rec.pending_drop_actives): DropEpochTask(
+                            k, self, n, e, a,
+                            on_done=lambda n=n, e=e: self.propose_op(
+                                {"op": DROP_DONE, "name": n, "epoch": e}
+                            ),
+                            fire_done_on_expire=False,
+                        ),
+                    )
+            elif rec.state is RCState.WAIT_ACK_STOP:
                 self.tasks.spawn_if_not_running(
                     f"stop:{name}",
                     lambda n=name, r=rec: StopEpochTask(
@@ -386,6 +560,7 @@ class Reconfigurator:
                         on_stopped=lambda: self.propose_op(
                             {"op": STOP_DONE, "name": n}
                         ),
+                        row=r.row,
                     ),
                 )
             elif rec.state is RCState.WAIT_ACK_START:
@@ -398,13 +573,18 @@ class Reconfigurator:
                     op = {"name": name, "epoch": rec.epoch,
                           "actives": rec.new_actives,
                           "initial_state": rec.initial_state}
+                # resume the row probe where the expired task left off —
+                # restarting at attempt 0 would re-collide forever against
+                # members already past it
+                op["attempt"] = self._last_attempt.get(name, 0)
+                skey = f"start:{name}:{op['epoch']}"
                 self.tasks.spawn_if_not_running(
-                    f"start:{name}",
-                    lambda k=f"start:{name}", o=op: StartEpochTask(k, self, o),
+                    skey,
+                    lambda k=skey, o=op: StartEpochTask(k, self, o),
                 )
             elif rec.state is RCState.WAIT_DELETE:
                 if self.tasks.is_running(f"stop:{name}") or \
-                        self.tasks.is_running(f"drop:{name}"):
+                        self.tasks.is_running(f"drop:{name}:{rec.epoch}"):
                     continue
                 epoch, actives = rec.epoch, list(rec.actives)
 
@@ -413,18 +593,24 @@ class Reconfigurator:
 
                 def after_stop(n=name, e=epoch, a=actives):
                     self.tasks.spawn_if_not_running(
-                        f"drop:{n}",
+                        f"drop:{n}:{e}",
                         lambda: DropEpochTask(
-                            f"drop:{n}", self, n, e, a, on_done=after_drop
+                            f"drop:{n}:{e}", self, n, e, a, on_done=after_drop
                         ),
                     )
 
                 self.tasks.spawn_if_not_running(
                     f"stop:{name}",
-                    lambda n=name, e=epoch, a=actives: StopEpochTask(
-                        f"stop:{n}", self, n, e, a, on_stopped=after_stop
+                    lambda n=name, e=epoch, a=actives, rw=rec.row:
+                    StopEpochTask(
+                        f"stop:{n}", self, n, e, a, on_stopped=after_stop,
+                        row=rw,
                     ),
                 )
+        # confirmed-commit entries for purged records / superseded epochs
+        self._commit_done &= {
+            (n, r.epoch) for n, r in self.rc_app.records.items()
+        }
 
     # ------------------------------------------------------------------
     # RC-record commit callbacks (CommitWorker execution path)
@@ -438,9 +624,10 @@ class Reconfigurator:
         rec = self.rc_app.get_record(name)
         kind = op["op"]
         if kind == CREATE_INTENT:
+            skey = f"start:{name}:{int(op.get('epoch', 0))}"
             self.tasks.spawn_if_not_running(
-                f"start:{name}",
-                lambda: StartEpochTask(f"start:{name}", self, {
+                skey,
+                lambda: StartEpochTask(skey, self, {
                     "name": name, "epoch": op.get("epoch", 0),
                     "actives": op["actives"],
                     "initial_state": op.get("initial_state"),
@@ -455,13 +642,15 @@ class Reconfigurator:
                     on_stopped=lambda: self.propose_op(
                         {"op": STOP_DONE, "name": name}
                     ),
+                    row=rec.row,
                 ),
             )
         elif kind == STOP_DONE:
             assert rec is not None
+            skey = f"start:{name}:{rec.epoch + 1}"
             self.tasks.spawn_if_not_running(
-                f"start:{name}",
-                lambda: StartEpochTask(f"start:{name}", self, {
+                skey,
+                lambda: StartEpochTask(skey, self, {
                     "name": name, "epoch": rec.epoch + 1,
                     "actives": rec.new_actives,
                     "prev_actives": rec.actives,
@@ -477,8 +666,41 @@ class Reconfigurator:
                           "create_ack" if was_create else "reconfigure_ack",
                           {"name": name, "ok": True, "actives": rec.actives,
                            "epoch": rec.epoch})
+            self._last_attempt.pop(name, None)  # probe settled
+            # lift the pre-COMPLETE admission gate on every new active
+            ckey = f"commit:{name}:{rec.epoch}"
+            self.tasks.spawn_if_not_running(
+                ckey, lambda: EpochCommitTask(
+                    ckey, self, name, rec.epoch, rec.actives, rec.row
+                )
+            )
             laggards = [a for a in rec.actives
                         if a not in (op.get("acked") or rec.actives)]
+
+            def spawn_prev_drop():
+                if was_create:
+                    return
+                # GC the previous epoch on its old actives — only after
+                # every laggard fetched its final state (or gave up):
+                # dropping purges the final-state donors.  Completion is
+                # committed as DROP_DONE so a restarted RC knows whether
+                # the round finished; expiry leaves the record's
+                # pending_drop set and the READY re-drive respawns it.
+                prev_actives = list(op.get("prev_actives") or [])
+                prev_epoch = int(op.get("prev_epoch", rec.epoch - 1))
+                self.tasks.spawn_if_not_running(
+                    f"drop:{name}:{prev_epoch}",
+                    lambda: DropEpochTask(
+                        f"drop:{name}:{prev_epoch}", self, name, prev_epoch,
+                        prev_actives,
+                        on_done=lambda: self.propose_op(
+                            {"op": DROP_DONE, "name": name,
+                             "epoch": prev_epoch}
+                        ),
+                        fire_done_on_expire=False,
+                    ),
+                )
+
             if laggards:
                 key = f"latestart:{name}:{rec.epoch}"
                 body = {
@@ -488,20 +710,16 @@ class Reconfigurator:
                     "prev_actives": op.get("prev_actives") or [],
                     "prev_epoch": int(op.get("prev_epoch", -1)),
                     "rc": ["RC", self.my_id],
+                    "committed": True,
                 }
                 self.tasks.spawn_if_not_running(
-                    key, lambda: LateStartTask(key, self, body, laggards)
+                    key, lambda: LateStartTask(
+                        key, self, body, laggards,
+                        on_finished=spawn_prev_drop,
+                    )
                 )
-            if not was_create:
-                # GC the previous epoch on its old actives
-                prev_actives = list(op.get("prev_actives") or [])
-                prev_epoch = int(op.get("prev_epoch", rec.epoch - 1))
-                self.tasks.spawn_if_not_running(
-                    f"drop:{name}",
-                    lambda: DropEpochTask(
-                        f"drop:{name}", self, name, prev_epoch, prev_actives,
-                    ),
-                )
+            else:
+                spawn_prev_drop()
         elif kind == DELETE_INTENT:
             assert rec is not None
             # stop the live epoch, then drop it everywhere, then purge the
@@ -514,9 +732,9 @@ class Reconfigurator:
 
             def after_stop():
                 self.tasks.spawn_if_not_running(
-                    f"drop:{name}",
+                    f"drop:{name}:{epoch}",
                     lambda: DropEpochTask(
-                        f"drop:{name}", self, name, epoch, actives,
+                        f"drop:{name}:{epoch}", self, name, epoch, actives,
                         on_done=after_drop,
                     ),
                 )
@@ -525,7 +743,7 @@ class Reconfigurator:
                 f"stop:{name}",
                 lambda: StopEpochTask(
                     f"stop:{name}", self, name, epoch, actives,
-                    on_stopped=after_stop,
+                    on_stopped=after_stop, row=rec.row,
                 ),
             )
         elif kind == DELETE_FINAL:

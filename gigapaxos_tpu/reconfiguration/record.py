@@ -42,6 +42,12 @@ class ReconfigurationRecord:
     # creation-time initial app state, kept so an expired/re-driven start
     # task can rebuild the StartEpoch without the original client request
     initial_state: Optional[str] = None
+    # the previous epoch still awaiting its drop round (GC on the old
+    # actives): kept ON the record — paxos-replicated — so an RC restart
+    # or primary handover can re-drive the drop instead of leaking the
+    # stopped rows forever; cleared by the DROP_DONE op
+    pending_drop_epoch: Optional[int] = None
+    pending_drop_actives: List[int] = field(default_factory=list)
 
     def to_json(self) -> Dict[str, Any]:
         return {
@@ -49,6 +55,8 @@ class ReconfigurationRecord:
             "actives": self.actives, "new_actives": self.new_actives,
             "row": self.row, "new_row": self.new_row, "deleted": self.deleted,
             "initial_state": self.initial_state,
+            "pending_drop_epoch": self.pending_drop_epoch,
+            "pending_drop_actives": self.pending_drop_actives,
         }
 
     @classmethod
@@ -59,6 +67,8 @@ class ReconfigurationRecord:
             row=int(d.get("row", -1)), new_row=int(d.get("new_row", -1)),
             deleted=bool(d.get("deleted", False)),
             initial_state=d.get("initial_state"),
+            pending_drop_epoch=d.get("pending_drop_epoch"),
+            pending_drop_actives=list(d.get("pending_drop_actives") or []),
         )
 
     # ---- transitions (setState analog, ReconfigurationRecord.java:466+) --
@@ -85,12 +95,23 @@ class ReconfigurationRecord:
         if self.state is not RCState.WAIT_ACK_START:
             return False
         if self.actives:
+            # the outgoing epoch owes a drop round on its old actives
+            self.pending_drop_epoch = self.epoch
+            self.pending_drop_actives = list(self.actives)
             self.epoch += 1
         self.actives = list(self.new_actives)
         self.row = self.new_row
         self.new_actives = []
         self.new_row = -1
         self.state = RCState.READY
+        return True
+
+    def drop_done(self) -> bool:
+        """The previous epoch's drop round reached every old active."""
+        if self.pending_drop_epoch is None:
+            return False
+        self.pending_drop_epoch = None
+        self.pending_drop_actives = []
         return True
 
     def start_delete(self) -> bool:

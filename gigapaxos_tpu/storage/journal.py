@@ -46,6 +46,9 @@ class BlockType(enum.IntEnum):
     PROMISES = 9    # cols: group, ballot — a bare promise (ballot rose with
     #                 no accompanying accept); ref: handlePrepare's
     #                 log-before-send of promise-upgrading prepare replies
+    UNPEND = 10     # cols: group — a pending (pre-COMPLETE) row confirmed
+    #                 by the reconfigurator's epoch_commit; clears the
+    #                 propose-refusal gate durably
 
 
 def _file_name(idx: int) -> str:

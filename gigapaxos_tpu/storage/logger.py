@@ -37,6 +37,7 @@ class RecoveredState:
         meta: Dict[str, Any],
         payloads: Dict[int, str],
         names: Dict[str, Dict[str, Any]],
+        pending_rows: Optional[set] = None,
     ):
         self.arrays = arrays          # None => fresh start
         self.meta = meta
@@ -44,6 +45,9 @@ class RecoveredState:
         # name -> [{row, version, init}, ...] in journal order (a name can
         # appear once per epoch: reconfiguration re-creates it at a new row)
         self.names = names
+        # rows still awaiting the reconfigurator's epoch_commit (the
+        # propose-refusal gate survives a restart)
+        self.pending_rows = pending_rows or set()
 
 
 class PaxosLogger:
@@ -75,7 +79,8 @@ class PaxosLogger:
             self.journal.append_columns(BlockType.PROMISES, [groups, bals])
 
     def log_create(
-        self, groups, masks, versions, coords, names=None, inits=None
+        self, groups, masks, versions, coords, names=None, inits=None,
+        pendings=None,
     ) -> None:
         if len(groups):
             self.journal.append_columns(
@@ -84,13 +89,20 @@ class PaxosLogger:
             if names is not None:
                 rows = [
                     {"row": int(g), "name": n, "version": int(v),
-                     "init": (None if inits is None else inits[i])}
+                     "init": (None if inits is None else inits[i]),
+                     "pending": bool(pendings[i]) if pendings else False}
                     for i, (g, n, v) in enumerate(zip(groups, names, versions))
                 ]
                 self.journal.append(
                     BlockType.NAMES,
                     json.dumps(rows, separators=(",", ":")).encode("utf-8"),
                 )
+
+    def log_unpend(self, groups) -> None:
+        """A pending (pre-COMPLETE) row was confirmed — durably clear the
+        propose-refusal gate so recovery doesn't resurrect it."""
+        if len(groups):
+            self.journal.append_columns(BlockType.UNPEND, [groups])
 
     def log_kill(self, groups) -> None:
         if len(groups):
@@ -141,6 +153,9 @@ class PaxosLogger:
             from_file, from_off = meta.get("journal_pos", [0, 0])
         payloads: Dict[int, str] = {}
         names: Dict[str, List[Dict[str, Any]]] = {}
+        # chronological pending-row tracking: checkpoint seed, then NAMES
+        # adds (pending creates), UNPEND/KILL clears, in scan order
+        pending: set = set(int(r) for r in meta.get("pending_rows") or [])
         for btype, payload, n_rows, _pos in self.journal.scan(from_file, from_off):
             if btype == BlockType.PAYLOADS:
                 payloads.update(
@@ -150,9 +165,20 @@ class PaxosLogger:
             if btype == BlockType.NAMES:
                 for ent in json.loads(payload.decode("utf-8")):
                     names.setdefault(ent["name"], []).append(ent)
+                    if ent.get("pending"):
+                        pending.add(int(ent["row"]))
+                    else:
+                        pending.discard(int(ent["row"]))
+                continue
+            if btype == BlockType.UNPEND:
+                for g in Journal.columns(payload, n_rows, 1)[:, 0]:
+                    pending.discard(int(g))
                 continue
             if btype == BlockType.CHECKPOINT:
                 continue
+            if btype == BlockType.KILL:
+                for g in Journal.columns(payload, n_rows, 1)[:, 0]:
+                    pending.discard(int(g))
             if arrays is None:
                 if seed_arrays is None:
                     raise ValueError(
@@ -160,7 +186,7 @@ class PaxosLogger:
                     )
                 arrays = {k: v.copy() for k, v in seed_arrays.items()}
             self._apply(arrays, btype, payload, n_rows, window, my_id)
-        return RecoveredState(arrays, meta, payloads, names)
+        return RecoveredState(arrays, meta, payloads, names, pending)
 
     @staticmethod
     def _apply(

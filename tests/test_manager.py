@@ -117,3 +117,76 @@ def test_stop_request_via_manager():
     c.run(5)
     assert (c.frontiers()[:, row] == before).all()
     c.close()
+
+
+def test_pending_row_gates_admission_until_commit():
+    """A start-epoch create is PENDING: proposals queue but nothing may
+    commit until the reconfigurator's epoch_commit confirms the row
+    (advisor r2: a pre-COMPLETE row move must never discard an
+    acknowledged write)."""
+    c = ManagerCluster(CFG, NoopPaxosApp)
+    row = c.managers[0].default_row_for("pend")
+    for m in c.managers:
+        m.create_paxos_instance("pend", [0, 1, 2], row=row, pending=True)
+    c.blobs = [m.blob() for m in c.managers]
+    got = {}
+    c.submit("pend", "v0", entry=0, callback=lambda rid, resp: got.update(r=resp))
+    c.run(8)
+    assert not got, "pending row executed a request before epoch_commit"
+    assert (np.asarray([m.state.n_execd for m in c.managers])[:, row] == 0).all()
+    for m in c.managers:
+        m.commit_row("pend", 0)
+    c.run(8)
+    assert got.get("r") == "noop-ack"
+    c.close()
+
+
+def test_pending_row_move_carries_held_queue():
+    """The probe moving a pending row recreates it at the new row; held
+    requests follow the name and execute after the commit."""
+    c = ManagerCluster(CFG, NoopPaxosApp)
+    for m in c.managers:
+        m.create_paxos_instance("mv", [0, 1, 2], row=1, pending=True)
+    got = {}
+    c.managers[0].propose("mv", "x", callback=lambda rid, resp: got.update(r=resp))
+    for m in c.managers:
+        assert m.create_paxos_instance("mv", [0, 1, 2], row=3, pending=True)
+        assert m.names["mv"] == 3
+        m.commit_row("mv", 0)
+    c.blobs = [m.blob() for m in c.managers]
+    c.run(10)
+    assert got.get("r") == "noop-ack"
+    c.close()
+
+
+def test_executed_row_refuses_same_epoch_move():
+    """A row that already executed decisions must refuse the move (raises,
+    surfacing as a collision NACK so the RC's probe converges back here)."""
+    c = ManagerCluster(CFG, NoopPaxosApp)
+    c.create("ex")  # non-pending; commits flow
+    row = c.managers[0].names["ex"]
+    c.submit("ex", "w", entry=0)
+    c.run(8)
+    assert int(np.asarray(c.managers[0].state.n_execd)[row]) > 0
+    with pytest.raises(RuntimeError, match="already executed"):
+        c.managers[0].create_paxos_instance(
+            "ex", [0, 1, 2], row=(row + 1) % CFG.n_groups, pending=True
+        )
+    c.close()
+
+
+def test_pending_gate_survives_restart(tmp_path):
+    """The propose-refusal gate is durable: a pending row recovers pending;
+    an unpended row recovers live (UNPEND journal block)."""
+    from gigapaxos_tpu.manager import PaxosManager
+
+    d = str(tmp_path / "n0")
+    cfg = EngineConfig(n_groups=6, window=8, req_lanes=4, n_replicas=3)
+    m = PaxosManager(0, NoopPaxosApp(), cfg, log_dir=d)
+    m.create_paxos_instance("a", [0, 1, 2], row=2, pending=True)
+    m.create_paxos_instance("b", [0, 1, 2], row=4, pending=True)
+    m.commit_row("b", 0, row=4)
+    m.close()
+    m2 = PaxosManager(0, NoopPaxosApp(), cfg, log_dir=d)
+    assert m2.pending_rows == {2}
+    m2.close()

@@ -243,7 +243,8 @@ def test_migration_survives_lossy_control_plane(monkeypatch):
 
     # fast retransmit so wall-clock restarts fire between test steps
     for cls in (rc_mod.StartEpochTask, rc_mod.StopEpochTask,
-                rc_mod.DropEpochTask, ar_mod.WaitEpochFinalState):
+                rc_mod.DropEpochTask, rc_mod.EpochCommitTask,
+                rc_mod.LateStartTask, ar_mod.WaitEpochFinalState):
         monkeypatch.setattr(cls, "restart_period_s", 0.02)
 
     ar_cfg = EngineConfig(n_groups=16, window=8, req_lanes=4, n_replicas=4)
