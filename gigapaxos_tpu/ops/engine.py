@@ -47,6 +47,17 @@ Ring convention: window lane ``j`` always holds slot ``s`` with
 ``s % W == j``.  All rings (accepted, decided, proposals) share it, so
 windows align lane-for-lane across replicas and the whole step is
 element-wise + [R]-axis reductions — no scatters, no dynamic shapes.
+
+TPU lowering note: the step deliberately contains NO gathers — no
+``argmax``+``take_along_axis`` row selection.  Measured on a v5e chip,
+each such gather inside the fused step cost ~50-100ms at G=1M (vs ~10ms
+for the rest of the step combined).  Every row/lane select is instead a
+masked max, which is sound by Paxos value-uniqueness: rows agreeing on
+(slot, ballot) necessarily hold the same value (one coordinator per
+ballot proposes one value per slot), so "pick any matching row" ==
+"masked max over matching rows".  Likewise the majority-rank frontier
+uses an O(R^2) rank count instead of a sort, and ``% W`` is a bitmask
+(W is required to be a power of two).
 """
 
 from __future__ import annotations
@@ -73,7 +84,11 @@ _BIG = jnp.int32(2 ** 30)
 
 
 class EngineConfig(NamedTuple):
-    """Static engine shape (all python ints — closed over by jit)."""
+    """Static engine shape (all python ints — closed over by jit).
+
+    ``window`` must be a power of two: lane residue (slot % W) compiles to
+    a bitmask, which matters on TPU where integer modulo is ~10x an AND.
+    """
 
     n_groups: int          # G: group capacity (PINSTANCES_CAPACITY analog)
     window: int = 16       # W: in-flight slots per group (ring size)
@@ -208,9 +223,14 @@ def step(
     ``AbstractPaxosLogger.java:157``).
     """
     G, W, K, R = cfg.n_groups, cfg.window, cfg.req_lanes, cfg.n_replicas
+    if W <= 0 or W & (W - 1):
+        # hard error (not an assert): under python -O a silent bitmask with
+        # a non-power-of-two W would map slots to wrong ring lanes
+        raise ValueError(f"window must be a power of two, got {W}")
     my_id = _i32(my_id)
     rids = jnp.arange(R, dtype=jnp.int32)
     lanes = jnp.arange(W, dtype=jnp.int32)
+    lane_of = lambda s: s & jnp.int32(W - 1)  # slot -> ring lane (W = 2^k)
 
     # [R, G] — which gathered rows are valid senders for each group:
     # heard and a member of the group (per-group replica subsets,
@@ -235,15 +255,17 @@ def step(
 
     # ---- 2. accept (handleAccept, PaxosAcceptor.acceptAndUpdateBallot) ----
     # Highest-ballot proposer wins; its ballot must equal the new promise.
-    r_star = jnp.argmax(in_prop, axis=0)                  # [G]
-    sel = lambda x: jnp.take_along_axis(x, r_star[None, :, None], axis=0)[0]
-    p_slot = sel(g.prop_slot)                             # [G, W]
-    p_vid = sel(g.prop_vid)
+    # Ballots encode the coordinator id, so at most ONE live row publishes
+    # max_prop — the masked max over winning rows IS that row's window
+    # (no argmax+gather; see the TPU lowering note in the module docstring).
+    win3 = ((in_prop == max_prop[None, :]) & (max_prop[None, :] != NULL))[:, :, None]
+    p_slot = jnp.where(win3, g.prop_slot, NULL).max(axis=0)   # [G, W]
+    p_vid = jnp.where(win3, g.prop_vid, NULL).max(axis=0)
     acc_ok = (max_prop == new_bal) & (max_prop != NULL) & (state.stopped == 0)
     exec2 = state.exec_slot[:, None]
     in_win = (
         (p_slot >= exec2) & (p_slot < exec2 + W) & (p_vid != NULL)
-        & ((p_slot % W) == lanes[None, :])                # ring-residue sanity
+        & (lane_of(p_slot) == lanes[None, :])             # ring-residue sanity
     )
     do_acc = acc_ok[:, None] & in_win
     acc_bal = jnp.where(do_acc, max_prop[:, None], state.acc_bal)
@@ -265,8 +287,9 @@ def step(
     match = match_s & (ga_bal == b_c[None])
     n_match = match.sum(axis=0)                           # [G, W]
     detected = (n_match >= maj[:, None]) & (s_c != NULL)
-    r_v = jnp.argmax(match, axis=0)                       # any matching row
-    det_vid = jnp.take_along_axis(g.acc_vid, r_v[None], axis=0)[0]
+    # matching rows agree on (slot, ballot) => same value (one coordinator
+    # per ballot): masked max == "any matching row"
+    det_vid = jnp.where(match, g.acc_vid, NULL).max(axis=0)
 
     # Decision candidates per lane: keep the SMALLEST undecided-needed slot
     # >= my frontier (so a lane never skips past an unexecuted decision).
@@ -278,9 +301,9 @@ def step(
     gd_slot = jnp.where(live3, g.dec_slot, NULL)
     gd_ok = (gd_slot != NULL) & (gd_slot >= exec2[None])
     gd_s = jnp.where(gd_ok, gd_slot, _BIG)
-    r_d = jnp.argmin(gd_s, axis=0)
-    c1_s = jnp.take_along_axis(gd_s, r_d[None], axis=0)[0]
-    c1_v = jnp.take_along_axis(g.dec_vid, r_d[None], axis=0)[0]
+    c1_s = gd_s.min(axis=0)                               # [G, W]
+    # rows at the min slot decided the SAME slot => same decided value
+    c1_v = jnp.where(gd_s == c1_s[None], g.dec_vid, NULL).max(axis=0)
     c2_s, c2_v = cand(s_c, det_vid, detected)
 
     best = jnp.minimum(jnp.minimum(c0_s, c1_s), c2_s)
@@ -294,11 +317,13 @@ def step(
 
     # ---- 4. execute: advance the in-order frontier (EEC analog,
     # PaxosInstanceStateMachine.extractExecuteAndCheckpoint:1511-1593) ----
+    # A lane holds frontier+o exactly when its decided slot equals it, so
+    # the lane->offset rotation is a [W, W] one-hot match, not a gather.
     slot_o = exec2 + lanes[None, :]                       # [G, W] frontier..+W
-    idx_o = slot_o % W
-    d_slot_at = jnp.take_along_axis(dec_slot, idx_o, axis=1)
-    d_vid_at = jnp.take_along_axis(dec_vid, idx_o, axis=1)
-    run = jnp.cumprod((d_slot_at == slot_o).astype(jnp.int32), axis=1)
+    eq_o = dec_slot[:, :, None] == slot_o[:, None, :]     # [G, Wlane, Woff]
+    d_hit = eq_o.any(axis=1)                              # [G, Woff]
+    d_vid_at = jnp.where(eq_o, dec_vid[:, :, None], NULL).max(axis=1)
+    run = jnp.cumprod(d_hit.astype(jnp.int32), axis=1)
     n_adv = run.sum(axis=1)                               # [G]
     exec_new = state.exec_slot + n_adv
 
@@ -317,10 +342,11 @@ def step(
     # Majority-rank execute frontier: the slot that >= majority of replicas
     # have executed past (the medianCheckpointedSlot GC watermark analog,
     # PValuePacket.medianCheckpointedSlot / nodeSlotNumbers piggybacking).
+    # k-th largest via O(R^2) rank count (no sort/gather): v is the maj-th
+    # largest iff #{rows >= v} >= maj, and the largest such v is exact.
     ge = jnp.where(live, g.exec_slot, NULL)
-    ge_sorted = -jnp.sort(-ge, axis=0)                    # descending [R, G]
-    maj_idx = jnp.clip(maj - 1, 0, R - 1)
-    maj_exec = jnp.take_along_axis(ge_sorted, maj_idx[None, :], axis=0)[0]
+    rank = (ge[:, None, :] <= ge[None, :, :]).sum(axis=1)  # [R, G]
+    maj_exec = jnp.where(rank >= maj[None, :], ge, NULL).max(axis=0)
     maj_exec = jnp.maximum(maj_exec, jnp.int32(0))
 
     # ---- 5. coordinator ----
@@ -370,9 +396,9 @@ def step(
     at_max = all_ok & (all_slot == co_slot[None])
     co_bal = jnp.where(at_max, all_bal, NULL).max(axis=0)
     pick = at_max & (all_bal == co_bal[None])
-    best_r = jnp.argmax(pick, axis=0)
     co_has = co_slot != NULL
-    co_vid = jnp.take_along_axis(all_vid, best_r[None], axis=0)[0]
+    # picked rows agree on (slot, ballot) => same accepted value
+    co_vid = jnp.where(pick, all_vid, NULL).max(axis=0)
 
     won = quorum
     phase = jnp.where(won, ACTIVE, phase)
@@ -396,7 +422,7 @@ def step(
 
     # Hole-filling no-ops: undecided slots in [floor, next) with no carryover
     # must be proposed as no-ops to unblock the frontier.
-    exp_slot = exec_new[:, None] + ((lanes[None, :] - exec_new[:, None]) % W)
+    exp_slot = exec_new[:, None] + lane_of(lanes[None, :] - exec_new[:, None])
     hole = (
         won2 & (exp_slot >= floor[:, None]) & (exp_slot < c_next[:, None])
         & (c_prop_slot != exp_slot) & (dec_slot != exp_slot)
@@ -444,9 +470,10 @@ def step(
     ks = jnp.arange(K, dtype=jnp.int32)
     bound = maj_exec + W
     cand_slot_k = c_next[:, None] + ks[None, :]           # [G, K]
-    cand_lane = cand_slot_k % W
-    lane_busy = jnp.take_along_axis(c_prop_slot != NULL, cand_lane, axis=1)
-    dec_at_cand = jnp.take_along_axis(dec_slot, cand_lane, axis=1)
+    cand_lane = lane_of(cand_slot_k)
+    oh_k = cand_lane[:, :, None] == lanes[None, None, :]  # [G, K, W] one-hot
+    lane_busy = (oh_k & (c_prop_slot != NULL)[:, None, :]).any(axis=2)
+    dec_at_cand = jnp.where(oh_k, dec_slot[:, None, :], NULL).max(axis=2)
     can_k = (
         may_admit[:, None] & (no_stop_before > 0)
         & (req_vid != NULL) & (cand_slot_k < bound[:, None]) & (~lane_busy)
@@ -454,7 +481,7 @@ def step(
     )
     admit = jnp.cumprod(can_k.astype(jnp.int32), axis=1)  # contiguous prefix
     n_admit = admit.sum(axis=1)                           # [G]
-    onehot = (cand_lane[:, :, None] == lanes[None, None, :]) & (admit[:, :, None] > 0)
+    onehot = oh_k & (admit[:, :, None] > 0)
     add_vid = jnp.where(onehot, req_vid[:, :, None], 0).sum(axis=1)
     add_slot = jnp.where(onehot, cand_slot_k[:, :, None], 0).sum(axis=1)
     newly = onehot.any(axis=1)
