@@ -1,0 +1,110 @@
+"""Shared async client substrate: one loop thread + per-address framed
+connections with a reply read-loop.
+
+Both clients (:class:`~gigapaxos_tpu.clients.paxos_client.PaxosClientAsync`
+and the reconfiguration-aware
+:class:`~gigapaxos_tpu.clients.reconfigurable_client.ReconfigurableAppClient`)
+speak the same ``MAGIC``+length framing to servers and match responses by
+id on the same connection (the reference pattern:
+``PaxosClientAsync.java:47-95`` under ``ReconfigurableAppClientAsync``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+from ..net.transport import MAGIC, _HDR
+from ..paxos_config import PC
+from ..utils.config import Config
+
+Addr = Tuple[str, int]
+
+
+class AsyncFrameClient:
+    """Loop thread + per-address connections; subclasses override
+    :meth:`_dispatch` for inbound frames."""
+
+    def __init__(self) -> None:
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self._loop.run_forever,
+            name=type(self).__name__, daemon=True,
+        )
+        self._thread.start()
+        self._conns: Dict[Addr, Tuple[asyncio.StreamReader, asyncio.StreamWriter]] = {}
+        self._read_tasks: Dict[Addr, asyncio.Task] = {}
+        self._lock = threading.Lock()
+        # flag snapshot (re-reading Config per message would contend on its
+        # global lock inside the response hot path)
+        self.callback_ttl = Config.get_float(PC.REQUEST_TIMEOUT_S)
+        # client ids live in [2^53, 2^62): disjoint from server-minted ids
+        # (namespaced vids < 2^31) and reconfiguration stop ids (bit 62 set);
+        # collision odds across clients negligible — the reference uses
+        # random 63-bit ids the same way (RequestPacket.java:83)
+        self._next_id = random.randrange(1 << 53, 1 << 62)
+
+    def mint_id(self) -> int:
+        with self._lock:
+            self._next_id += 1
+            return self._next_id
+
+    # ---- transport -----------------------------------------------------
+    def send_frame(self, addr: Addr, frame: bytes) -> None:
+        asyncio.run_coroutine_threadsafe(self._asend(addr, frame), self._loop)
+
+    async def _asend(self, addr: Addr, frame: bytes) -> None:
+        conn = self._conns.get(addr)
+        if conn is None:
+            try:
+                reader, writer = await asyncio.open_connection(addr[0], addr[1])
+            except OSError:
+                return
+            self._conns[addr] = (reader, writer)
+            self._read_tasks[addr] = self._loop.create_task(
+                self._read_loop(addr, reader)
+            )
+            conn = (reader, writer)
+        _r, writer = conn
+        try:
+            writer.write(_HDR.pack(MAGIC, len(frame)) + frame)
+            await writer.drain()
+        except (ConnectionError, OSError):
+            self._conns.pop(addr, None)
+
+    async def _read_loop(self, addr: Addr, reader: asyncio.StreamReader) -> None:
+        try:
+            while True:
+                hdr = await reader.readexactly(_HDR.size)
+                magic, length = _HDR.unpack(hdr)
+                if magic != MAGIC:
+                    break
+                payload = await reader.readexactly(length)
+                self._dispatch(payload)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            self._conns.pop(addr, None)
+
+    def _dispatch(self, payload: bytes) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        async def _close():
+            for task in self._read_tasks.values():
+                task.cancel()
+            for _r, w in list(self._conns.values()):
+                try:
+                    w.close()
+                    await w.wait_closed()
+                except Exception:
+                    pass
+            self._conns.clear()
+
+        try:
+            asyncio.run_coroutine_threadsafe(_close(), self._loop).result(3)
+        except Exception:
+            pass
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=3)

@@ -9,36 +9,21 @@ servers answer duplicates from the response cache (exactly-once).
 
 from __future__ import annotations
 
-import asyncio
 import random
-import struct
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..net.codec import decode_json, decode_kind, encode_json
-from ..net.transport import MAGIC, _HDR
-
-CALLBACK_TIMEOUT_S = 8.0  # PaxosClientAsync callback GC timeout analog
+from .base import AsyncFrameClient
 
 
-class PaxosClientAsync:
+class PaxosClientAsync(AsyncFrameClient):
     def __init__(self, servers: List[Tuple[str, int]], my_tag: int = -1):
+        super().__init__()
         self.servers = list(servers)
         self.my_tag = my_tag
-        self._loop = asyncio.new_event_loop()
-        self._thread = threading.Thread(
-            target=self._loop.run_forever, name="paxos-client", daemon=True
-        )
-        self._thread.start()
-        self._conns: Dict[int, Tuple[asyncio.StreamReader, asyncio.StreamWriter]] = {}
         self._callbacks: Dict[int, Tuple[float, Callable]] = {}
-        # client ids live in [2^53, 2^62): disjoint from server-minted ids
-        # (namespaced vids < 2^31), collision odds across clients
-        # negligible — the reference uses random 63-bit ids the same way
-        # (RequestPacket.java:83)
-        self._next_id = random.randrange(1 << 53, 1 << 62)
-        self._lock = threading.Lock()
 
     # ---- public API ----------------------------------------------------
     def send_request(
@@ -51,19 +36,16 @@ class PaxosClientAsync:
         request_id: Optional[int] = None,
     ) -> int:
         """Fire a request; returns its request id (for retransmission)."""
+        if request_id is None:
+            request_id = self.mint_id()
         with self._lock:
-            if request_id is None:
-                self._next_id += 1
-                request_id = self._next_id
             if callback is not None:
                 self._callbacks[request_id] = (time.time(), callback)
         idx = random.randrange(len(self.servers)) if server is None else server
         body = {"name": name, "value": value,
                 "request_id": request_id, "stop": stop}
         frame = encode_json("client_request", self.my_tag, body)
-        asyncio.run_coroutine_threadsafe(
-            self._send(idx, frame), self._loop
-        )
+        self.send_frame(tuple(self.servers[idx]), frame)
         return request_id
 
     def send_request_sync(
@@ -111,7 +93,7 @@ class PaxosClientAsync:
             self._admin_waiters = getattr(self, "_admin_waiters", {})
             self._admin_waiters[key] = (ev, fut_box)
         frame = encode_json("admin", self.my_tag, body)
-        asyncio.run_coroutine_threadsafe(self._send(server, frame), self._loop)
+        self.send_frame(tuple(self.servers[server]), frame)
         if ev.wait(timeout):
             return fut_box.get("resp")
         return None
@@ -135,52 +117,6 @@ class PaxosClientAsync:
             ok = ok and bool(resp and resp.get("ok"))
         return ok
 
-    def close(self) -> None:
-        async def _close():
-            for _r, w in self._conns.values():
-                try:
-                    w.close()
-                except Exception:
-                    pass
-
-        try:
-            asyncio.run_coroutine_threadsafe(_close(), self._loop).result(3)
-        except Exception:
-            pass
-        self._loop.call_soon_threadsafe(self._loop.stop)
-        self._thread.join(timeout=3)
-
-    # ---- internals ------------------------------------------------------
-    async def _send(self, idx: int, frame: bytes) -> None:
-        conn = self._conns.get(idx)
-        if conn is None:
-            host, port = self.servers[idx]
-            try:
-                reader, writer = await asyncio.open_connection(host, port)
-            except OSError:
-                return
-            self._conns[idx] = (reader, writer)
-            self._loop.create_task(self._read_loop(idx, reader))
-            conn = (reader, writer)
-        _r, writer = conn
-        try:
-            writer.write(_HDR.pack(MAGIC, len(frame)) + frame)
-            await writer.drain()
-        except (ConnectionError, OSError):
-            self._conns.pop(idx, None)
-
-    async def _read_loop(self, idx: int, reader: asyncio.StreamReader) -> None:
-        try:
-            while True:
-                hdr = await reader.readexactly(_HDR.size)
-                magic, length = struct.unpack(">II", hdr)
-                if magic != MAGIC:
-                    break
-                payload = await reader.readexactly(length)
-                self._dispatch(payload)
-        except (asyncio.IncompleteReadError, ConnectionError, OSError):
-            self._conns.pop(idx, None)
-
     def _dispatch(self, payload: bytes) -> None:
         if decode_kind(payload) != "J":
             return
@@ -189,8 +125,9 @@ class PaxosClientAsync:
             rid = int(body["request_id"])
             with self._lock:
                 ent = self._callbacks.pop(rid, None)
-                # GC stale callbacks while we're here
-                cut = time.time() - CALLBACK_TIMEOUT_S
+                # GC stale callbacks while we're here (REQUEST_TIMEOUT_S
+                # snapshot, the PaxosClientAsync 8s callback GC analog)
+                cut = time.time() - self.callback_ttl
                 for dead in [r for r, (t, _) in self._callbacks.items() if t < cut]:
                     del self._callbacks[dead]
             if ent:

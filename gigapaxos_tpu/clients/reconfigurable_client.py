@@ -1,0 +1,254 @@
+"""ReconfigurableAppClient — the reconfiguration-aware client.
+
+API-parity target: ``ReconfigurableAppClientAsync``
+(``ReconfigurableAppClientAsync.java:75,798-1404``): resolve a name's
+active replicas through any reconfigurator, cache with TTL, send app
+requests to actives, refresh on ``unknown_name`` (a request landing
+mid-migration), and expose the create/delete/reconfigure name API.
+
+Wire shape (shared substrate: :mod:`gigapaxos_tpu.clients.base`): app
+requests are ``client_request`` frames to actives (answered
+``client_response`` on the same connection); reconfigurator ops are
+``rc_client`` frames to any RC (answered ``rc_client_reply``, possibly
+relayed from the record's primary — see
+:mod:`gigapaxos_tpu.reconfigurable_node`).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..net.codec import decode_json, decode_kind, encode_json
+from ..reconfiguration.rc_config import RC
+from ..utils.config import Config
+from .base import Addr, AsyncFrameClient
+
+
+class ReconfigurableAppClient(AsyncFrameClient):
+    def __init__(
+        self,
+        actives: Dict[int, Addr],
+        reconfigurators: List[Addr],
+        my_tag: int = -1,
+    ):
+        super().__init__()
+        self.actives = dict(actives)
+        self.reconfigurators = list(reconfigurators)
+        self.my_tag = my_tag
+        self.cache_ttl = Config.get_float(RC.ACTIVES_CACHE_TTL_S)
+        # name -> (expiry, [active ids]) — the TTL'd request->actives table
+        self._actives_cache: Dict[str, Tuple[float, List[int]]] = {}
+        # app-request callbacks: request_id -> (time, cb(rid, resp, error))
+        self._callbacks: Dict[int, Tuple[float, Callable]] = {}
+        # rc-op waiters: (ack_kind, name) -> (event, box)
+        self._rc_waiters: Dict[Tuple[str, str], Tuple[threading.Event, Dict]] = {}
+
+    @classmethod
+    def from_properties(cls) -> "ReconfigurableAppClient":
+        """Build the address books from ``active.*``/``reconfigurator.*``
+        config entries (ids by sorted name, matching NodeConfig)."""
+        ar = Config.node_addresses("active")
+        rc = Config.node_addresses("reconfigurator")
+        return cls(
+            {i: ar[n] for i, n in enumerate(sorted(ar))},
+            [rc[n] for n in sorted(rc)],
+        )
+
+    # ------------------------------------------------------------------
+    # name management (create/delete/reconfigure via any RC)
+    # ------------------------------------------------------------------
+    def _rc_op_sync(
+        self, kind: str, ack_kind: str, name: str, body: Dict,
+        timeout: float = 10.0, retransmit_every: float = 1.0,
+    ) -> Optional[Dict]:
+        ev = threading.Event()
+        box: Dict = {}
+        key = (ack_kind, name)
+        with self._lock:
+            self._rc_waiters[key] = (ev, box)
+        frame = encode_json("rc_client", self.my_tag, {"kind": kind, "body": body})
+        deadline = time.time() + timeout
+        i = random.randrange(len(self.reconfigurators))
+        try:
+            while True:
+                self.send_frame(
+                    self.reconfigurators[i % len(self.reconfigurators)], frame
+                )
+                i += 1  # rotate RCs on retransmit (ops are idempotent)
+                if ev.wait(retransmit_every):
+                    return box.get("body")
+                if time.time() > deadline:
+                    return None
+        finally:
+            with self._lock:
+                self._rc_waiters.pop(key, None)
+
+    def create_name(
+        self, name: str, initial_state: Optional[str] = None,
+        actives: Optional[List[int]] = None, timeout: float = 10.0,
+    ) -> Optional[Dict]:
+        body = {"name": name, "initial_state": initial_state}
+        if actives is not None:
+            body["actives"] = list(actives)
+        ack = self._rc_op_sync(
+            "create_service", "create_ack", name, body, timeout
+        )
+        if ack and not ack.get("ok") and ack.get("reason") == "exists":
+            # A slow create's RETRANSMIT can find the record this client
+            # just created and answer "exists" ahead of the relayed ok —
+            # confirm via resolution (retried creates are success-if-exists,
+            # the reference's DuplicateNameException handling).
+            acts = self.request_actives(name, force=True)
+            if acts:
+                return {"name": name, "ok": True, "actives": acts,
+                        "existed": True}
+        return ack
+
+    def delete_name(self, name: str, timeout: float = 10.0) -> Optional[Dict]:
+        ack = self._rc_op_sync(
+            "delete_service", "delete_ack", name, {"name": name}, timeout
+        )
+        if ack and not ack.get("ok") and ack.get("reason") == "unknown":
+            # a completed delete's retransmit finds no record — confirm the
+            # name is really gone (idempotent delete semantics).  Poll a
+            # few times: a lagging RC may still serve the purged record
+            # for a tick or two (RSM application skew).
+            for _ in range(4):
+                if self.request_actives(name, force=True) is None:
+                    self.invalidate(name)
+                    return {"name": name, "ok": True, "already_deleted": True}
+                time.sleep(0.5)
+        self.invalidate(name)
+        return ack
+
+    def reconfigure(
+        self, name: str, new_actives: List[int], timeout: float = 15.0
+    ) -> Optional[Dict]:
+        return self._rc_op_sync(
+            "reconfigure", "reconfigure_ack", name,
+            {"name": name, "new_actives": list(new_actives)}, timeout,
+        )
+
+    def request_actives(
+        self, name: str, timeout: float = 5.0, force: bool = False
+    ) -> Optional[List[int]]:
+        """Resolve the name's current actives (TTL cache; RC on miss)."""
+        now = time.time()
+        with self._lock:
+            ent = self._actives_cache.get(name)
+            if ent and ent[0] > now and not force:
+                return list(ent[1])
+        resp = self._rc_op_sync(
+            "request_actives", "actives_response", name, {"name": name}, timeout
+        )
+        if not resp or not resp.get("ok"):
+            return None
+        acts = [int(a) for a in resp["actives"]]
+        with self._lock:
+            self._actives_cache[name] = (now + self.cache_ttl, acts)
+        return acts
+
+    def invalidate(self, name: str) -> None:
+        with self._lock:
+            self._actives_cache.pop(name, None)
+
+    # ------------------------------------------------------------------
+    # app requests (to actives, with unknown_name refresh)
+    # ------------------------------------------------------------------
+    def send_request(
+        self,
+        name: str,
+        value: str,
+        callback: Callable,  # cb(request_id, response, error)
+        stop: bool = False,
+        request_id: Optional[int] = None,
+        active: Optional[int] = None,
+    ) -> Optional[int]:
+        acts = self.request_actives(name)
+        if acts is not None:
+            # only actives this client can actually address (a stale RC
+            # answer may name a node missing from the local address book)
+            acts = [a for a in acts if int(a) in self.actives]
+        if not acts:
+            return None
+        target = active if active is not None else random.choice(acts)
+        addr = self.actives.get(int(target))
+        if addr is None:
+            return None
+        if request_id is None:
+            request_id = self.mint_id()
+        with self._lock:
+            self._callbacks[request_id] = (time.time(), callback)
+        self.send_frame(addr, encode_json("client_request", self.my_tag, {
+            "name": name, "value": value,
+            "request_id": request_id, "stop": stop,
+        }))
+        return request_id
+
+    def send_request_sync(
+        self, name: str, value: str, timeout: float = 10.0,
+        stop: bool = False, retransmit_every: float = 0.5,
+    ) -> Optional[str]:
+        """Blocking request with retransmission and mid-migration recovery:
+        an ``unknown_name`` answer (the active no longer hosts the name —
+        reconfigured away, or not yet confirmed) invalidates the cache and
+        the retry resolves fresh actives through the RCs."""
+        ev = threading.Event()
+        out: Dict = {}
+
+        def cb(rid, resp, error):
+            if error:
+                self.invalidate(name)
+                ev.set()  # wake the loop for an immediate re-resolve
+                return
+            out["resp"] = resp
+            out["done"] = True
+            ev.set()
+
+        rid = None
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            ev.clear()
+            rid = self.send_request(
+                name, value, cb, stop=stop, request_id=rid
+            )
+            if rid is None:  # resolution failed; brief backoff then retry
+                time.sleep(0.1)
+                continue
+            ev.wait(retransmit_every)
+            if out.get("done"):
+                with self._lock:
+                    self._callbacks.pop(rid, None)
+                return out.get("resp")
+        if rid is not None:
+            with self._lock:
+                self._callbacks.pop(rid, None)
+        return None
+
+    # ------------------------------------------------------------------
+    def _dispatch(self, payload: bytes) -> None:
+        if decode_kind(payload) != "J":
+            return
+        k, _s, body = decode_json(payload)
+        if k == "client_response":
+            rid = int(body["request_id"])
+            with self._lock:
+                ent = self._callbacks.get(rid)
+                if not body.get("error"):
+                    self._callbacks.pop(rid, None)
+                cut = time.time() - self.callback_ttl
+                for dead in [r for r, (t, _) in self._callbacks.items() if t < cut]:
+                    del self._callbacks[dead]
+            if ent:
+                ent[1](rid, body.get("response"), body.get("error"))
+        elif k == "rc_client_reply":
+            kind = body.get("kind")
+            b = body.get("body") or {}
+            with self._lock:
+                ent = self._rc_waiters.get((kind, b.get("name")))
+            if ent:
+                ent[1]["body"] = b
+                ent[0].set()

@@ -17,14 +17,13 @@ one vectorized pass producing the engine's ``want_coord`` mask:
 from __future__ import annotations
 
 import time
-from typing import Dict, Iterable
+from typing import Dict, Iterable, Optional
 
 import numpy as np
 
 from .ops.ballot import ballot_coord
-
-NODE_TIMEOUT_S = 6.0          # PaxosConfig FAILURE_DETECTION_TIMEOUT analog
-LONG_DEAD_FACTOR = 3.0        # coordinator_failure_detection_timeout = 3x
+from .paxos_config import PC
+from .utils.config import Config
 
 
 class FailureDetector:
@@ -32,10 +31,13 @@ class FailureDetector:
         self,
         my_id: int,
         node_ids: Iterable[int],
-        timeout_s: float = NODE_TIMEOUT_S,
+        timeout_s: Optional[float] = None,
     ):
         self.my_id = int(my_id)
+        if timeout_s is None:
+            timeout_s = Config.get_float(PC.FAILURE_DETECTION_TIMEOUT_S)
         self.timeout_s = timeout_s
+        self.long_dead_factor = Config.get_float(PC.COORDINATOR_LONG_DEAD_FACTOR)
         now = time.time()
         self.last_heard: Dict[int, float] = {int(n): now for n in node_ids}
 
@@ -69,7 +71,7 @@ class FailureDetector:
         R = n_replicas
         up = np.array([self.is_node_up(r) for r in range(R)], bool)
         long_dead = np.array(
-            [self.dead_for(r) > self.timeout_s * LONG_DEAD_FACTOR
+            [self.dead_for(r) > self.timeout_s * self.long_dead_factor
              for r in range(R)], bool,
         )
         coord = np.asarray(ballot_coord(np.asarray(bal))) % R

@@ -43,6 +43,8 @@ import numpy as np
 
 from .interfaces.app import Replicable
 from .ops.ballot import NULL, ballot_coord
+from .paxos_config import PC
+from .utils.config import Config
 from .ops.engine import (
     STOP_BIT,
     Blob,
@@ -69,7 +71,9 @@ class Outstanding:
     """Entry-replica callback table with TTL GC (GCConcurrentHashMap analog,
     ``PaxosManager.java:192-207``)."""
 
-    def __init__(self, timeout_s: float = 8.0):
+    def __init__(self, timeout_s: Optional[float] = None):
+        if timeout_s is None:
+            timeout_s = Config.get_float(PC.REQUEST_TIMEOUT_S)
         self.timeout_s = timeout_s
         self._map: Dict[int, Tuple[float, Callable]] = {}
 
@@ -98,22 +102,39 @@ class PaxosManager:
         app: Replicable,
         cfg: EngineConfig,
         log_dir: Optional[str] = None,
-        sync_journal: bool = False,
-        checkpoint_every: int = 400,   # CHECKPOINT_INTERVAL slots analog
+        sync_journal: Optional[bool] = None,
+        checkpoint_every: Optional[int] = None,  # CHECKPOINT_INTERVAL analog
+        jump_horizon: Optional[int] = None,      # slots; None -> flag * W
     ):
         self.my_id = int(my_id)
         self.app = app
         self.cfg = cfg
         G, W, K = cfg.n_groups, cfg.window, cfg.req_lanes
 
+        # explicit ctor args win; otherwise the three-tier flag system
+        # decides (defaults < properties file < env/CLI — PaxosConfig.PC)
+        if sync_journal is None:
+            sync_journal = Config.get_bool(PC.SYNC_JOURNAL)
+        if not Config.get_bool(PC.ENABLE_JOURNALING):
+            log_dir = None
         self.logger: Optional[PaxosLogger] = (
-            PaxosLogger(my_id, log_dir, sync=sync_journal) if log_dir else None
+            PaxosLogger(
+                my_id, log_dir, sync=sync_journal,
+                max_file_size=Config.get_int(PC.MAX_LOG_FILE_SIZE),
+            ) if log_dir else None
         )
-        self.checkpoint_every = checkpoint_every
+        self.checkpoint_every = (
+            Config.get_int(PC.CHECKPOINT_INTERVAL)
+            if checkpoint_every is None else checkpoint_every
+        )
         # members lagging more than this many slots behind the majority
         # are written off for payload retention and recover via checkpoint
         # transfer (MAX_SYNC_DECISIONS_GAP analog)
-        self.jump_horizon = 4 * cfg.window
+        self.jump_horizon = (
+            Config.get_int(PC.JUMP_HORIZON_WINDOWS) * cfg.window
+            if jump_horizon is None else int(jump_horizon)
+        )
+        self.response_cache_ttl = Config.get_float(PC.RESPONSE_CACHE_TTL_S)
 
         # host-side tables
         self.names: Dict[str, int] = {}        # service name -> CURRENT epoch row
@@ -1082,7 +1103,7 @@ class PaxosManager:
         })
         self._slots_since_ckpt = 0
         # response-cache GC piggybacks on checkpoint cadence
-        cut = time.time() - 60.0
+        cut = time.time() - self.response_cache_ttl
         for key in [k for k, (t, _) in self.response_cache.items() if t < cut]:
             del self.response_cache[key]
 

@@ -65,6 +65,7 @@ from __future__ import annotations
 from typing import NamedTuple
 
 import jax.numpy as jnp
+import numpy as np
 
 from .ballot import NULL, ballot_num, encode_ballot
 
@@ -80,7 +81,10 @@ ACTIVE = 2
 NOOP_VID = 0
 STOP_BIT = 1 << 30
 
-_BIG = jnp.int32(2 ** 30)
+# numpy scalar, NOT jnp: a module-scope jnp constant initializes the JAX
+# backend at import time — deadly when a site hook pins a remote backend
+# whose init can hang (the process never reaches the code that pins cpu)
+_BIG = np.int32(2 ** 30)
 
 
 class EngineConfig(NamedTuple):

@@ -23,6 +23,10 @@ class PC(enum.Enum):
     MAX_BATCH_SIZE = 2000                # client requests coalesced per proposal batch
 
     # ---- TPU engine shape (new; no reference counterpart) -------------
+    # allocated dense engine rows for a deployed node (HBM/RAM cost is
+    # O(ENGINE_ROWS * SLOT_WINDOW)); PINSTANCES_CAPACITY above is the
+    # design CEILING (2M ref parity) — raise ENGINE_ROWS toward it on TPU
+    ENGINE_ROWS = 65536
     SLOT_WINDOW = 16                     # W: in-flight slots per group (ring buffer)
     DEFAULT_NUM_REPLICAS = 3
     GROUP_BLOCK = 1024                   # group-count padding quantum (lane friendliness)
@@ -48,6 +52,13 @@ class PC(enum.Enum):
     COORDINATOR_LONG_DEAD_FACTOR = 3.0   # long-dead at 3x timeout
     SYNC_THRESHOLD = 32                  # missing decisions before sync kicks in
     MAX_SYNC_DECISIONS_GAP = 1 << 14
+    # payload-retention/jump horizon in units of the slot window: a member
+    # more than this many windows behind the majority frontier is written
+    # off for payload retention and recovers via checkpoint transfer
+    # (MAX_SYNC_DECISIONS_GAP plays this role in the reference)
+    JUMP_HORIZON_WINDOWS = 4
+    TICK_INTERVAL_S = 0.01               # server drive-loop cadence
+    RESPONSE_CACHE_TTL_S = 60.0          # exactly-once retransmit cache TTL
 
     # ---- pause / residency (ref: PaxosConfig.java:277,291) ------------
     PAUSE_OPTION = True

@@ -1,0 +1,42 @@
+"""Reconfiguration-layer flags — the ReconfigurationConfig analog.
+
+Re-creation of the reference's ``ReconfigurationConfig.RC`` flag enum
+(``reconfiguration/ReconfigurationConfig.java:142-404``), keeping the
+reference's names and defaults where the concept survives, plus knobs for
+the TPU build's task re-drive machinery.  Register with
+:class:`gigapaxos_tpu.utils.Config` and read via ``Config.get(RC.FLAG)``.
+"""
+
+from __future__ import annotations
+
+import enum
+
+from ..utils.config import Config
+
+
+class RC(enum.Enum):
+    # ---- placement (ref: ReconfigurationConfig.java DEFAULT_NUM_REPLICAS)
+    DEFAULT_NUM_REPLICAS = 3
+
+    # ---- demand-driven reconfiguration (ref: DEMAND_PROFILE_TYPE,
+    # AbstractDemandProfile SPI) — the dotted path of the profile class
+    DEMAND_PROFILE_TYPE = (
+        "gigapaxos_tpu.reconfiguration.demand.DemandProfile"
+    )
+    # actives report aggregated demand to the RC every this many requests
+    DEMAND_REPORT_EVERY = 64
+
+    # ---- task re-drive machinery (TPU-build specific) ------------------
+    REDRIVE_EVERY = 32          # reconfigurator ticks between record scans
+    MAX_REDROPS = 8             # retry budget for post-delete straggler drops
+
+    # ---- delete (ref: ReconfigurationConfig MAX_FINAL_STATE_AGE 3600s;
+    # here the explicit drop rounds + redrops subsume the age-out, this
+    # caps how long a served final state is retained for laggard fetches)
+    MAX_FINAL_STATE_AGE_S = 3600.0
+
+    # ---- client (ref: ReconfigurableAppClientAsync caches) -------------
+    ACTIVES_CACHE_TTL_S = 60.0  # client-side name -> actives cache TTL
+
+
+Config.register(RC)

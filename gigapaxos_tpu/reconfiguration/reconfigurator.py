@@ -26,7 +26,9 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..manager import PaxosManager
 from ..protocoltask import ProtocolExecutor, ProtocolTask, ThresholdProtocolTask
+from ..utils.config import Config
 from .chash import ConsistentHashing
+from .rc_config import RC
 from .rc_app import (
     COMPLETE,
     CREATE_INTENT,
@@ -302,14 +304,25 @@ class Reconfigurator:
         actives: List[int],
         reconfigurators: List[int],
         send: Callable[[Addr, str, Dict], None],
-        default_replicas: int = 3,   # RC.DEFAULT_NUM_REPLICAS analog
+        default_replicas: Optional[int] = None,  # None -> RC.DEFAULT_NUM_REPLICAS
+        ar_n_groups: Optional[int] = None,       # row space of the AR engine
     ):
         self.my_id = int(my_id)
         self.rc_manager = rc_manager
         self.rc_app = rc_app
         self.send = send
-        self.n_groups = rc_manager.cfg.n_groups  # row space of the AR engine
-        self.default_replicas = default_replicas
+        # rows are probed in the APP engine's row space; default to the RC
+        # engine's only for legacy in-process setups that share the shape
+        self.n_groups = (
+            rc_manager.cfg.n_groups if ar_n_groups is None else int(ar_n_groups)
+        )
+        self.default_replicas = (
+            Config.get_int(RC.DEFAULT_NUM_REPLICAS)
+            if default_replicas is None else int(default_replicas)
+        )
+        self.REDRIVE_EVERY = Config.get_int(RC.REDRIVE_EVERY)
+        self.MAX_REDROPS = Config.get_int(RC.MAX_REDROPS)
+        self.ar_ids = set(int(a) for a in actives)
         self.ar_ring = ConsistentHashing(actives)
         self.rc_ring = ConsistentHashing(reconfigurators)
         self.tasks = ProtocolExecutor(send=lambda m: self.send(m[0], m[1], m[2]))
@@ -382,8 +395,6 @@ class Reconfigurator:
             self._redrive_records()
             self._redrive_unfinished_drops()
 
-    MAX_REDROPS = 8  # retry budget for post-delete straggler drops
-
     def note_unfinished_drop(
         self, name: str, epoch: int, stragglers: List[int]
     ) -> None:
@@ -436,6 +447,10 @@ class Reconfigurator:
         actives = body.get("actives") or self.ar_ring.get_replicated_servers(
             name, self.default_replicas
         )
+        if self._bad_actives(actives):
+            self._reply(body, "create_ack", name, ok=False,
+                        reason="bad-actives")
+            return
         if body.get("client") is not None:
             self._pending_clients[name] = body["client"]
         self.propose_op({
@@ -464,6 +479,21 @@ class Reconfigurator:
             else:
                 self._reply(body, "reconfigure_ack", name, ok=False,
                             reason="not-ready")
+            return
+        if self._bad_actives(body["new_actives"]):
+            # an unknown/empty target set would commit an epoch bump whose
+            # start round can never complete — the record would wedge in
+            # WAIT_ACK_START forever with no error to anyone
+            self._reply(body, "reconfigure_ack", name, ok=False,
+                        reason="bad-actives")
+            return
+        if sorted(rec.actives) == sorted(body["new_actives"]):
+            # already at the target set: a completed migration's delayed
+            # retransmit must NOT start a redundant epoch bump (the
+            # reference skips same-set reconfigurations unless
+            # RECONFIGURE_IN_PLACE, ReconfigurationConfig.java:268)
+            self._reply(body, "reconfigure_ack", name, ok=True,
+                        actives=rec.actives, epoch=rec.epoch)
             return
         new_actives = body["new_actives"]
         if body.get("client") is not None:
@@ -508,6 +538,9 @@ class Reconfigurator:
                     epoch=(rec.epoch if ok else -1),
                     row=(rec.row if ok else -1))
 
+    def _bad_actives(self, actives) -> bool:
+        return not actives or any(int(a) not in self.ar_ids for a in actives)
+
     def _reply(self, body: Dict, kind: str, name: str, **fields) -> None:
         client = body.get("client")
         if client is not None:
@@ -518,8 +551,6 @@ class Reconfigurator:
     # record mid-transition — the owner periodically respawns the pending
     # step (CommitWorker re-propose + WaitPrimaryExecution retry analog)
     # ------------------------------------------------------------------
-    REDRIVE_EVERY = 32  # tick() calls between record scans
-
     def _redrive_records(self) -> None:
         for name, rec in list(self.rc_app.records.items()):
             if rec.deleted or not self.is_primary(name):

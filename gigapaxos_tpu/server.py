@@ -37,6 +37,8 @@ from .net.codec import (
 from .net.node_config import NodeConfig
 from .net.transport import MessageTransport
 from .ops.engine import Blob, EngineConfig
+from .paxos_config import PC
+from .utils.config import Config
 
 
 class PaxosServer:
@@ -47,8 +49,8 @@ class PaxosServer:
         app,
         cfg: EngineConfig,
         log_dir: Optional[str] = None,
-        tick_interval: float = 0.01,
-        fd_timeout_s: float = 2.0,
+        tick_interval: Optional[float] = None,
+        fd_timeout_s: Optional[float] = None,
     ):
         self.my_id = int(my_id)
         self.node_config = node_config
@@ -56,7 +58,10 @@ class PaxosServer:
         self.manager = PaxosManager(my_id, app, cfg, log_dir=log_dir)
         self.transport = MessageTransport(my_id, node_config, self._on_message)
         self.fd = FailureDetector(my_id, node_config.get_node_ids(), fd_timeout_s)
-        self.tick_interval = tick_interval
+        self.tick_interval = (
+            Config.get_float(PC.TICK_INTERVAL_S)
+            if tick_interval is None else tick_interval
+        )
         self._peer_blobs: Dict[int, Blob] = {}
         self._blob_lock = threading.Lock()
         self._tick = 0
@@ -89,7 +94,14 @@ class PaxosServer:
         k, sender, body = decode_json(payload)
         if sender >= 0:
             self.fd.heard_from(sender)
-        if k in ("payloads", "forward", "need_payloads"):
+        self._on_json(k, sender, body, reply)
+
+    def _on_json(self, k: str, sender: int, body: Dict, reply) -> bool:
+        """JSON-frame dispatch; subclasses extend (ReconfigurableNode roles
+        layer epoch-plane kinds on the same demux — the reference's
+        precedePacketDemultiplexer chaining).  Returns True if handled."""
+        if k in ("payloads", "forward", "need_payloads",
+                 "state_request", "state_reply"):
             self.manager.on_host_message(k, body)
         elif k == "fd_ping":
             pass  # hearing it is the point (any traffic counts as alive)
@@ -97,6 +109,9 @@ class PaxosServer:
             self._on_client_request(body, reply)
         elif k == "admin":
             self._on_admin(body, reply)
+        else:
+            return False
+        return True
 
     def _on_client_request(self, body: Dict, reply) -> None:
         request_id = int(body["request_id"])
@@ -206,3 +221,8 @@ class PaxosServer:
             ping = encode_json("fd_ping", self.my_id, {"t": now})
             for r in peers:
                 self.transport.send_to_id(r, ping)
+
+        self._layer_tick()
+
+    def _layer_tick(self) -> None:
+        """Per-tick hook for layered roles (AR/RC protocol tasks)."""

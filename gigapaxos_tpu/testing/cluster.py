@@ -24,8 +24,8 @@ class ManagerCluster:
         cfg: EngineConfig,
         make_app: Callable[[], object],
         log_dirs: Optional[List[str]] = None,
-        sync_journal: bool = False,
-        checkpoint_every: int = 400,
+        sync_journal: Optional[bool] = None,
+        checkpoint_every: Optional[int] = None,
     ):
         R = cfg.n_replicas
         self.cfg = cfg

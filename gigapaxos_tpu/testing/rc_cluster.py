@@ -57,6 +57,7 @@ class ReconfigurableCluster:
             mgr = self.rcs.managers[j]
             self.reconfigurators.append(Reconfigurator(
                 j, mgr, mgr.app, self.ar_ids, self.rc_ids, self._sender(),
+                ar_n_groups=ar_cfg.n_groups,
             ))
         # bootstrap the RC-record RSM on every reconfigurator (the
         # AR_RC_NODES-style special group, created deterministically)

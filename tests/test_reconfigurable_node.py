@@ -1,0 +1,142 @@
+"""Deployable reconfigurable node over real sockets — loopback_rc_simple
+parity (ref: ``tests/loopback_rc_simple/testing.properties`` +
+``ReconfigurableNode.java:223-300``): boot 3 actives + 3 reconfigurators
+as socket servers from properties config, then drive create -> requests ->
+migrate -> delete through the reconfiguration-aware client
+(``ReconfigurableAppClientAsync`` analog), including a request served
+from a stale actives cache mid-migration."""
+
+import socket
+import time
+
+import pytest
+
+from gigapaxos_tpu.clients.reconfigurable_client import ReconfigurableAppClient
+from gigapaxos_tpu.models.apps import HashChainApp
+from gigapaxos_tpu.ops.engine import EngineConfig
+from gigapaxos_tpu.reconfigurable_node import ReconfigurableNode
+from gigapaxos_tpu.utils.config import Config
+
+
+def free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ports = free_ports(6)
+    Config.clear()
+    for i in range(3):
+        Config.set(f"active.AR{i}", f"127.0.0.1:{ports[i]}")
+        Config.set(f"reconfigurator.RC{i}", f"127.0.0.1:{ports[3 + i]}")
+    ar_cfg = EngineConfig(n_groups=32, window=8, req_lanes=4, n_replicas=3)
+    rc_cfg = EngineConfig(n_groups=8, window=8, req_lanes=4, n_replicas=3)
+    nodes = [
+        ReconfigurableNode(f"AR{i}", HashChainApp, ar_cfg=ar_cfg, rc_cfg=rc_cfg)
+        for i in range(3)
+    ] + [
+        ReconfigurableNode(f"RC{i}", HashChainApp, ar_cfg=ar_cfg, rc_cfg=rc_cfg)
+        for i in range(3)
+    ]
+    for n in nodes:
+        n.start()
+    client = ReconfigurableAppClient.from_properties()
+    yield nodes, client
+    client.close()
+    for n in nodes:
+        n.stop()
+    Config.clear()
+
+
+def ar_server(nodes, i):
+    return nodes[i].servers[0]
+
+
+def test_create_request_migrate_delete_over_sockets(cluster):
+    nodes, client = cluster
+
+    # --- create through the RCs --------------------------------------
+    ack = client.create_name("svc", actives=[0, 1, 2], timeout=30)
+    assert ack and ack.get("ok"), ack
+    assert sorted(ack["actives"]) == [0, 1, 2]
+
+    # --- resolve + app requests through epoch 0 ----------------------
+    acts = client.request_actives("svc", timeout=10)
+    assert sorted(acts) == [0, 1, 2]
+    for i in range(5):
+        resp = client.send_request_sync("svc", f"r{i}", timeout=20)
+        assert resp is not None, f"request r{i} timed out"
+
+    apps = [ar_server(nodes, i).manager.app for i in range(3)]
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        states = [a.state.get("svc") for a in apps]
+        if states[0] is not None and states[0] == states[1] == states[2]:
+            break
+        time.sleep(0.1)
+    assert states[0] == states[1] == states[2], states
+
+    # --- migrate [0,1,2] -> [1,2] (node 0 leaves) ---------------------
+    ack = client.reconfigure("svc", [1, 2], timeout=40)
+    assert ack and ack.get("ok"), ack
+    assert sorted(ack["actives"]) == [1, 2] and ack["epoch"] == 1
+
+    # old epoch drops off node 0 (best-effort; bounded wait)
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        if ar_server(nodes, 0).manager.names.get("svc") is None:
+            break
+        time.sleep(0.1)
+    assert ar_server(nodes, 0).manager.names.get("svc") is None
+
+    # --- stale-cache request lands at the departed active ------------
+    # poison the cache so the next request targets node 0, which no
+    # longer hosts the name: unknown_name -> invalidate -> re-resolve
+    with client._lock:
+        client._actives_cache["svc"] = (time.time() + 60, [0])
+    resp = client.send_request_sync("svc", "post-migration", timeout=20)
+    assert resp is not None, "mid-migration request did not recover"
+    acts = client.request_actives("svc")
+    assert sorted(acts) == [1, 2]
+
+    # state continuity on the new epoch
+    a1 = ar_server(nodes, 1).manager.app
+    a2 = ar_server(nodes, 2).manager.app
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if a1.state.get("svc") == a2.state.get("svc") and \
+                a1.n_executed.get("svc", 0) >= 6:
+            break
+        time.sleep(0.1)
+    assert a1.state.get("svc") == a2.state.get("svc")
+    assert a1.n_executed.get("svc", 0) >= 6  # 5 pre + 1 post migration
+
+    # --- delete -------------------------------------------------------
+    ack = client.delete_name("svc", timeout=40)
+    assert ack and ack.get("ok"), ack
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if all(ar_server(nodes, i).manager.names.get("svc") is None
+               for i in (1, 2)):
+            break
+        time.sleep(0.1)
+    for i in (1, 2):
+        assert ar_server(nodes, i).manager.names.get("svc") is None
+    # record purged on every reconfigurator (DELETE_FINAL application may
+    # lag the client ack by a few ticks on non-primary RCs)
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        if all(nodes[i].servers[0].rc_app.get_record("svc") is None
+               for i in (3, 4, 5)):
+            break
+        time.sleep(0.1)
+    for i in (3, 4, 5):
+        assert nodes[i].servers[0].rc_app.get_record("svc") is None

@@ -48,3 +48,41 @@ def test_profiler():
     assert DelayProfiler.get("lat") == 1.0
     assert DelayProfiler.get("reqs") == 5
     assert "lat" in DelayProfiler.get_stats()
+
+
+def test_flags_reach_the_framework(tmp_path):
+    """VERDICT r2 item 5: the three-tier flag system must actually control
+    the framework — a properties file changes the manager's checkpoint
+    cadence/jump horizon and the failure detector's timeout."""
+    from gigapaxos_tpu.failure_detection import FailureDetector
+    from gigapaxos_tpu.manager import PaxosManager
+    from gigapaxos_tpu.models import NoopPaxosApp
+    from gigapaxos_tpu.ops.engine import EngineConfig
+    from gigapaxos_tpu.reconfiguration.rc_config import RC
+    from gigapaxos_tpu.utils.config import Config
+
+    props = tmp_path / "gigapaxos.properties"
+    props.write_text(
+        "CHECKPOINT_INTERVAL=7\n"
+        "JUMP_HORIZON_WINDOWS=2\n"
+        "FAILURE_DETECTION_TIMEOUT_S=1.5\n"
+        "REQUEST_TIMEOUT_S=3.0\n"
+        "RC.DEFAULT_NUM_REPLICAS=5\n"
+    )
+    Config.clear()
+    try:
+        Config.load_file(str(props))
+        cfg = EngineConfig(n_groups=4, window=8, req_lanes=4, n_replicas=3)
+        m = PaxosManager(0, NoopPaxosApp(), cfg)
+        assert m.checkpoint_every == 7
+        assert m.jump_horizon == 2 * 8
+        assert m.outstanding.timeout_s == 3.0
+        fd = FailureDetector(0, [0, 1, 2])
+        assert fd.timeout_s == 1.5
+        assert Config.get_int(RC.DEFAULT_NUM_REPLICAS) == 5
+        # CLI tier beats the file tier
+        Config.register_args(["CHECKPOINT_INTERVAL=11"])
+        m2 = PaxosManager(1, NoopPaxosApp(), cfg)
+        assert m2.checkpoint_every == 11
+    finally:
+        Config.clear()
