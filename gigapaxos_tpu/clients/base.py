@@ -63,11 +63,18 @@ class AsyncFrameClient:
                 reader, writer = await asyncio.open_connection(addr[0], addr[1])
             except OSError:
                 return
-            self._conns[addr] = (reader, writer)
-            self._read_tasks[addr] = self._loop.create_task(
-                self._read_loop(addr, reader)
-            )
-            conn = (reader, writer)
+            raced = self._conns.get(addr)
+            if raced is not None:
+                # a concurrent send connected while we awaited — keep the
+                # established one, discard ours (else its writer leaks)
+                writer.close()
+                conn = raced
+            else:
+                self._conns[addr] = (reader, writer)
+                self._read_tasks[addr] = self._loop.create_task(
+                    self._read_loop(addr, reader)
+                )
+                conn = (reader, writer)
         _r, writer = conn
         try:
             writer.write(_HDR.pack(MAGIC, len(frame)) + frame)

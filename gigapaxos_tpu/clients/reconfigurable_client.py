@@ -64,27 +64,36 @@ class ReconfigurableAppClient(AsyncFrameClient):
         self, kind: str, ack_kind: str, name: str, body: Dict,
         timeout: float = 10.0, retransmit_every: float = 1.0,
     ) -> Optional[Dict]:
-        ev = threading.Event()
-        box: Dict = {}
-        key = (ack_kind, name)
-        with self._lock:
-            self._rc_waiters[key] = (ev, box)
+        """One RC op with retransmission.  A "not-ready" answer (record
+        mid-transition — e.g. a paused name being reactivated by this very
+        touch) is retried until the deadline rather than surfaced."""
         frame = encode_json("rc_client", self.my_tag, {"kind": kind, "body": body})
         deadline = time.time() + timeout
         i = random.randrange(len(self.reconfigurators))
-        try:
-            while True:
+        last: Optional[Dict] = None
+        while time.time() < deadline:
+            ev = threading.Event()
+            box: Dict = {}
+            key = (ack_kind, name)
+            with self._lock:
+                self._rc_waiters[key] = (ev, box)
+            try:
                 self.send_frame(
                     self.reconfigurators[i % len(self.reconfigurators)], frame
                 )
                 i += 1  # rotate RCs on retransmit (ops are idempotent)
-                if ev.wait(retransmit_every):
-                    return box.get("body")
-                if time.time() > deadline:
-                    return None
-        finally:
-            with self._lock:
-                self._rc_waiters.pop(key, None)
+                if not ev.wait(retransmit_every):
+                    continue
+                last = box.get("body")
+            finally:
+                with self._lock:
+                    self._rc_waiters.pop(key, None)
+            if last and not last.get("ok") and \
+                    last.get("reason") in ("not-ready", "paused"):
+                time.sleep(min(0.25, retransmit_every))
+                continue
+            return last
+        return last
 
     def create_name(
         self, name: str, initial_state: Optional[str] = None,

@@ -60,6 +60,14 @@ from .utils.profiler import DelayProfiler
 
 _step_jit = jax.jit(step, static_argnames=("cfg",))
 
+
+def _mix32(h: int, vid: int) -> int:
+    """Host mirror of the engine's app-hash fold (int32 wraparound)."""
+    with np.errstate(over="ignore"):
+        h32 = np.int32(h)
+        v32 = np.int32(vid)
+        return int((h32 * np.int32(31) + v32) ^ (v32 << np.int32(7)))
+
 # vid layout: [node_id : 5][counter : 24] under STOP_BIT (bit 30) — the
 # counter wraps per node at ~16M in-flight request payloads, far above the
 # outstanding cap; node ids follow ballot.COORD_BITS (ids 0..31).
@@ -153,6 +161,11 @@ class PaxosManager:
         # (the reconfiguration layer captures the final state here);
         # signature: (name, row, epoch)
         self.on_stop_executed: Optional[Callable[[str, int, int], None]] = None
+        # residency (pause/unpause, PaxosManager.java:2264-2392 analog):
+        # paused groups' snapshots, keyed (name, epoch) — their rows are
+        # freed for reuse; reactivation restores at a freshly probed row
+        self.paused: Dict[Tuple[str, int], Dict] = {}
+        self.row_activity = np.zeros(G, np.float64)  # wall time of last use
         self.arena: Dict[int, str] = {}        # vid -> request payload (json str)
         self.vid_meta: Dict[int, Tuple[int, int]] = {}  # vid -> (entry_replica, request_id)
         self.outstanding = Outstanding()
@@ -217,6 +230,8 @@ class PaxosManager:
         for k, v in (meta.get("vid_meta") or {}).items():
             self.vid_meta.setdefault(int(k), (v[0], v[1]))
         self.arena.update(rec.payloads)  # journal blocks are newer
+        for k, v in rec.payload_meta.items():
+            self.vid_meta.setdefault(int(k), (int(v[0]), int(v[1])))
         self.names = {str(k): int(v) for k, v in meta.get("names", {}).items()}
         self.old_epochs = {
             (str(n), int(e)): int(r)
@@ -285,9 +300,96 @@ class PaxosManager:
         for name, init in journal_inits.items():
             if name not in app_states:
                 self.app.restore(name, init)
-        # decisions after the checkpoint replay through the engine (its
-        # exec frontier resumes from the snapshot), and the host cursor
-        # re-executes them once payloads re-enter via the journal arena.
+        # residency: fold pause records (LAST — checkpoint app-state and
+        # cursor restoration above must not overwrite the fold).  A name
+        # live at the same epoch was RESUMED: the pause record's frontier /
+        # ballot / app state must survive (the resume-create replays empty,
+        # and a forgotten promise could accept an older-ballot proposal).
+        # A name not live stays paused and reactivates from self.paused.
+        arrays = None
+        for (nm, e), prec in rec.pause_records.items():
+            r = self.names.get(nm)
+            if r is not None and int(versions[r]) == e:
+                if arrays is None:
+                    arrays = {
+                        k: np.asarray(v).copy()
+                        for k, v in self.state._asdict().items()
+                    }
+                # the safety bits (promised ballot, accepted/decided window
+                # remnants) fold even at EQUAL frontiers — a record with
+                # exec == replayed frontier can still carry a promise the
+                # replayed create forgot (bal bumped without execution)
+                if int(prec["exec"]) >= int(arrays["exec_slot"][r]):
+                    arrays["bal"][r] = max(int(arrays["bal"][r]), int(prec["bal"]))
+                    for slot, b, vid in prec.get("acc") or []:
+                        lane = slot % self.cfg.window
+                        if slot > int(arrays["acc_slot"][r, lane]):
+                            arrays["acc_slot"][r, lane] = slot
+                            arrays["acc_bal"][r, lane] = b
+                            arrays["acc_vid"][r, lane] = vid
+                    for slot, vid in prec.get("dec") or []:
+                        lane = slot % self.cfg.window
+                        if slot > int(arrays["dec_slot"][r, lane]):
+                            arrays["dec_slot"][r, lane] = slot
+                            arrays["dec_vid"][r, lane] = vid
+                if int(prec["exec"]) > int(arrays["exec_slot"][r]):
+                    arrays["exec_slot"][r] = int(prec["exec"])
+                    arrays["app_hash"][r] = int(prec["app_hash"])
+                    arrays["n_execd"][r] = int(prec["n_execd"])
+                    self.app.restore(nm, prec.get("app_state"))
+                    self.app_exec_slot[r] = int(prec["exec"])
+                    self.pending_exec.pop(r, None)
+            elif nm not in self.names:
+                self.paused[(nm, e)] = prec
+        # Roll the execute frontier forward through EVERY journaled
+        # decision (the rings only hold the last W per group — a group
+        # that decided more than W slots since its checkpoint would
+        # otherwise wedge at the snapshot frontier forever).  The device
+        # hash chain advances with the same fold the engine uses; host
+        # execution happens via pending_exec on the first ticks.
+        if rec.decisions:
+            if arrays is None:
+                arrays = {
+                    k: np.asarray(v).copy()
+                    for k, v in self.state._asdict().items()
+                }
+            old_rows = set(self.old_epochs.values())
+            for g, decs in rec.decisions.items():
+                if int(masks[g]) == 0 or g in old_rows:
+                    continue  # killed / stopped-prior-epoch rows stay put
+                s = int(arrays["exec_slot"][g])
+                h = int(arrays["app_hash"][g])
+                ne = int(arrays["n_execd"][g])
+                base = s
+                while s in decs:
+                    vid = decs[s]
+                    if vid > 0:
+                        h = _mix32(h, vid)
+                        ne += 1
+                    s += 1
+                if s > base:
+                    arrays["exec_slot"][g] = s
+                    arrays["app_hash"][g] = h
+                    arrays["n_execd"][g] = ne
+                    arrays["c_next_slot"][g] = max(
+                        int(arrays["c_next_slot"][g]), s
+                    )
+                pend = self.pending_exec.setdefault(g, {})
+                cursor = int(self.app_exec_slot[g])
+                for slot, vid in decs.items():
+                    if slot >= cursor:
+                        pend.setdefault(slot, vid)
+                if not pend:
+                    del self.pending_exec[g]
+        if arrays is not None:
+            self.state = EngineState(
+                **{k: jnp.asarray(v) for k, v in arrays.items()}
+            )
+        # synchronous rollforward through the app (initiateRecovery parity:
+        # the reference fully replays before serving); slots whose payloads
+        # are not local stay pending and heal via runtime peer pulls
+        self._drain_pending_exec()
+        self._fired_callbacks.clear()  # no clients to answer at recovery
 
     # ------------------------------------------------------------------
     # lifecycle (createPaxosInstance / kill, PaxosManager.java:611,2142)
@@ -301,6 +403,8 @@ class PaxosManager:
         needs no such step because it keys everything by paxosID string)."""
         import zlib
 
+        if name in self.names:
+            return self.names[name]  # idempotent re-create (e.g. recovery)
         G = self.cfg.n_groups
         row = zlib.crc32(name.encode("utf-8")) % G
         for _ in range(G):
@@ -395,6 +499,7 @@ class PaxosManager:
         self.app_exec_slot[row] = 0
         self.queues.pop(row, None)
         self.pending_exec.pop(row, None)
+        self.row_activity[row] = time.time()
         if held_vids:
             self.queues[row] = held_vids
         if self.logger:
@@ -450,6 +555,15 @@ class PaxosManager:
         the reconfigurator garbage-collects the old epoch once the new one
         is running)."""
         with self._state_lock:
+            # a paused group being deleted has no row — drop the record
+            # with a journal tombstone (else the PAUSE block resurrects it
+            # on recovery, and a later re-created incarnation of the name
+            # could restore the dead incarnation's state)
+            if self.paused.pop((name, int(epoch)), None) is not None \
+                    and self.logger:
+                self.logger.log_pause({
+                    "name": name, "epoch": int(epoch), "dropped": True,
+                })
             row = self.old_epochs.pop((name, epoch), None)
             if row is None:
                 # dropping the current epoch is only legal if it's stopped
@@ -470,6 +584,168 @@ class PaxosManager:
             self.queues.pop(row, None)
             self.pending_exec.pop(row, None)
             return True
+
+    # ------------------------------------------------------------------
+    # residency: pause / resume (syncAndDeactivate + unpause analog,
+    # PaxosManager.java:2264-2392,2786-2881 — RC-coordinated here because
+    # rows must stay aligned across replicas for the blob exchange)
+    # ------------------------------------------------------------------
+    def pause_group(self, name: str, epoch: int, force: bool = False) -> str:
+        """Free (name, epoch)'s row, snapshotting its state to the journal
+        and `self.paused`.  Returns "ok", "unknown" (not hosted here — an
+        already-paused or never-started member just acks), or "busy"
+        (non-quiescent and not forced: traffic resumed, pause should be
+        cancelled).  `force` carries window remnants into the record (used
+        by re-homing, where quiescence can't be awaited)."""
+        with self._state_lock:
+            row = self.names.get(name)
+            if row is None:
+                return "ok" if (name, int(epoch)) in self.paused else "unknown"
+            if int(np.asarray(self.state.version)[row]) != int(epoch):
+                return "unknown"
+            if int(np.asarray(self.state.stopped)[row]):
+                return "busy"  # stopping group: the delete path owns it
+            exec_now = int(np.asarray(self.state.exec_slot)[row])
+            quiescent = (
+                not self.queues.get(row)
+                and not self.pending_exec.get(row)
+                and int(self.app_exec_slot[row]) == exec_now
+                and int(np.asarray(self.state.acc_slot)[row].max()) < exec_now
+            )
+            if not quiescent and not force:
+                return "busy"
+            rec = self._extract_record(name, int(epoch), row)
+            held = list(self.queues.get(row, []))
+            if held:
+                # unadmitted requests survive the pause in the record's
+                # shadow queue (journaled WITH the record — a crash while
+                # paused must not drop them); the resume re-queues them
+                rec["held_vids"] = held
+            if self.logger:
+                self.logger.log_pause(rec)
+            self.paused[(name, int(epoch))] = rec
+            self._kill_locked(name)
+            return "ok"
+
+    def _extract_record(self, name: str, epoch: int, row: int) -> Dict:
+        """Snapshot one row for pause/re-home (HotRestoreInfo analog)."""
+        s = self.state
+        exec_now = int(np.asarray(s.exec_slot)[row])
+        acc = []
+        dec = []
+        acc_slot = np.asarray(s.acc_slot)[row]
+        acc_bal = np.asarray(s.acc_bal)[row]
+        acc_vid = np.asarray(s.acc_vid)[row]
+        dec_slot = np.asarray(s.dec_slot)[row]
+        dec_vid = np.asarray(s.dec_vid)[row]
+        for lane in range(self.cfg.window):
+            if int(acc_slot[lane]) >= exec_now:
+                acc.append([int(acc_slot[lane]), int(acc_bal[lane]),
+                            int(acc_vid[lane])])
+            if int(dec_slot[lane]) >= exec_now:
+                dec.append([int(dec_slot[lane]), int(dec_vid[lane])])
+        return {
+            "name": name, "epoch": epoch,
+            "exec": exec_now,
+            "bal": int(np.asarray(s.bal)[row]),
+            "app_hash": int(np.asarray(s.app_hash)[row]),
+            "n_execd": int(np.asarray(s.n_execd)[row]),
+            "app_state": self.app.checkpoint(name),
+            "app_exec": int(self.app_exec_slot[row]),
+            "acc": acc, "dec": dec,
+        }
+
+    def resume_group(
+        self, name: str, epoch: int, members: List[int], row: int,
+        pending: bool = True,
+    ) -> bool:
+        """Reactivate (name, epoch) at `row` (the RC's freshly probed row).
+
+        Three cases: still hosting live (re-home: carry full state over),
+        holding a pause record (restore it), or neither (fresh empty join —
+        the straggler state-transfer heals it).  Raises RuntimeError when
+        `row` is occupied by another group (-> collision NACK)."""
+        epoch = int(epoch)
+        with self._state_lock:
+            cur = self.names.get(name)
+            if cur is not None:
+                cur_ver = int(np.asarray(self.state.version)[cur])
+                if cur_ver > epoch:
+                    return False
+                if cur_ver == epoch:
+                    if int(row) == cur:
+                        if not pending and cur in self.pending_rows:
+                            self._unpend_locked(cur)
+                        return True
+                    # live re-home: snapshot (with window remnants), free
+                    # the old row, fall through to restore at the new one
+                    if self.pause_group(name, epoch, force=True) != "ok":
+                        return False
+            rec = self.paused.pop((name, epoch), None)
+            if int(row) in self.row_name:
+                if rec is not None:
+                    self.paused[(name, epoch)] = rec  # keep for next probe
+                raise RuntimeError(
+                    f"row {row} already hosts {self.row_name[int(row)]!r}"
+                )
+            if rec is None:
+                # no local state at all: join empty and heal via state
+                # transfer once the group runs
+                return self._create_locked(
+                    name, members, None, epoch, int(row), pending
+                )
+            ok = self._create_locked(
+                name, members, rec.get("app_state"), epoch, int(row), pending
+            )
+            if not ok:
+                self.paused[(name, epoch)] = rec
+                return False
+            r = int(row)
+            arrays = {
+                k: np.asarray(v).copy()
+                for k, v in self.state._asdict().items()
+            }
+            arrays["exec_slot"][r] = int(rec["exec"])
+            arrays["bal"][r] = max(int(arrays["bal"][r]), int(rec["bal"]))
+            arrays["app_hash"][r] = int(rec["app_hash"])
+            arrays["n_execd"][r] = int(rec["n_execd"])
+            arrays["c_next_slot"][r] = int(rec["exec"])
+            for slot, b, vid in rec.get("acc") or []:
+                lane = slot % self.cfg.window
+                arrays["acc_slot"][r, lane] = slot
+                arrays["acc_bal"][r, lane] = b
+                arrays["acc_vid"][r, lane] = vid
+            for slot, vid in rec.get("dec") or []:
+                lane = slot % self.cfg.window
+                arrays["dec_slot"][r, lane] = slot
+                arrays["dec_vid"][r, lane] = vid
+            self.state = EngineState(
+                **{k: jnp.asarray(v) for k, v in arrays.items()}
+            )
+            self.app_exec_slot[r] = int(rec.get("app_exec", rec["exec"]))
+            # the _create_locked journal entry has the app state as init;
+            # the consensus remnants need the pause record on replay too
+            if self.logger:
+                self.logger.log_pause(rec)
+            held = rec.get("held_vids") or []
+            if held:
+                self.queues[r] = [v for v in held if v in self.arena]
+            self.row_activity[r] = time.time()
+            return True
+
+    def idle_names(self, idle_s: float) -> List[Tuple[str, int]]:
+        """(name, epoch) of current-epoch groups with no traffic for
+        `idle_s` seconds (Deactivator sweep candidates)."""
+        out = []
+        cut = time.time() - idle_s
+        with self._state_lock:
+            versions = np.asarray(self.state.version)
+            for name, row in self.names.items():
+                if row in self.pending_rows or self.queues.get(row):
+                    continue
+                if self.row_activity[row] < cut:
+                    out.append((name, int(versions[row])))
+        return out
 
     def get_replica_group(self, name: str) -> Optional[List[int]]:
         row = self.names.get(name)
@@ -560,6 +836,7 @@ class PaxosManager:
                 if callback is not None:
                     self.outstanding.put(request_id, callback)
                 self.queues.setdefault(row, []).append(vid)
+                self.row_activity[row] = time.time()
         if cached_hit:
             if callback:
                 callback(request_id, cached_response)
@@ -578,10 +855,22 @@ class PaxosManager:
 
     def _on_host_message_locked(self, kind: str, body: Dict) -> None:
         if kind == "payloads":
+            fresh: Dict[int, str] = {}
             for k, v in body["arena"].items():
-                self.arena.setdefault(int(k), v)
+                k = int(k)
+                if k not in self.arena:
+                    self.arena[k] = v
+                    fresh[k] = v
             for k, meta in body.get("meta", {}).items():
                 self.vid_meta.setdefault(int(k), (meta[0], meta[1]))
+            if fresh and self.logger is not None:
+                # peer-replicated payloads must be durable HERE too: if
+                # only the admitting coordinator persisted them, a
+                # coordinator-only crash could lose decided-but-unexecuted
+                # values for everyone
+                self.logger.log_payloads(fresh, meta={
+                    k: self.vid_meta[k] for k in fresh if k in self.vid_meta
+                })
             ae = body.get("app_exec")
             if ae is not None:
                 rid, cursors = ae
@@ -771,7 +1060,7 @@ class PaxosManager:
                     acc_vid[gs, lanes],
                 )
             if payload_delta:
-                self.logger.log_payloads(payload_delta)
+                self.logger.log_payloads(payload_delta, meta=meta_delta)
 
         self._execute(out_np)
         self._maybe_request_state(out_np)
@@ -808,12 +1097,29 @@ class PaxosManager:
                 np.array(rows, np.int32), np.array(slots, np.int32),
                 np.array(vids, np.int32),
             )
+        if len(committed):
+            self.row_activity[committed] = time.time()
         for g in committed:
             base = int(out_np.exec_base[g])
             pend = self.pending_exec.setdefault(int(g), {})
             for o in range(int(out_np.n_committed[g])):
                 pend[base + o] = int(out_np.exec_vid[g, o])
-        # drain in order, payload-gated
+        missing = self._drain_pending_exec()
+        if missing:
+            self.forward_out.append(
+                (-1, "need_payloads", {"vids": missing, "from": self.my_id})
+            )
+        # retention GC: drop payloads every live member has executed past
+        if self._tick_no % 32 == 0 and self.retained:
+            for vid, (g, slot) in list(self.retained.items()):
+                if slot < self._min_exec[g]:
+                    del self.retained[vid]
+                    self.arena.pop(vid, None)
+                    self.vid_meta.pop(vid, None)
+
+    def _drain_pending_exec(self) -> List[int]:
+        """Execute decided slots in order through the app, payload-gated;
+        returns vids whose payloads are missing (to pull from peers)."""
         missing: List[int] = []
         for g in list(self.pending_exec.keys()):
             pend = self.pending_exec[g]
@@ -829,17 +1135,7 @@ class PaxosManager:
             self.app_exec_slot[g] = cursor
             if not pend:
                 del self.pending_exec[g]
-        if missing:
-            self.forward_out.append(
-                (-1, "need_payloads", {"vids": missing, "from": self.my_id})
-            )
-        # retention GC: drop payloads every live member has executed past
-        if self._tick_no % 32 == 0 and self.retained:
-            for vid, (g, slot) in list(self.retained.items()):
-                if slot < self._min_exec[g]:
-                    del self.retained[vid]
-                    self.arena.pop(vid, None)
-                    self.vid_meta.pop(vid, None)
+        return missing
 
     def _execute_one(self, name: Optional[str], g: int, slot: int, vid: int) -> bool:
         from .packets.paxos_packets import RequestPacket
@@ -1091,6 +1387,9 @@ class PaxosManager:
         self.logger.checkpoint(arrays, app_states, {
             "names": self.names,
             "pending_rows": sorted(self.pending_rows),
+            "paused": {
+                f"{n}@{e}": rec for (n, e), rec in self.paused.items()
+            },
             "old_epochs": [[n, e, r] for (n, e), r in self.old_epochs.items()],
             "next_counter": self._next_counter,
             "arena": self.arena,

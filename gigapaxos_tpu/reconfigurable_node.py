@@ -88,6 +88,7 @@ class ActiveReplicaServer(PaxosServer):
         self.active_replica = ActiveReplica(
             my_id, self.coordinator,
             _EpochSender(self, ar_nodes, rc_nodes),
+            rc_ids=rc_nodes.get_node_ids(),
         )
         # LOCK ORDER: transport threads take layer_lock -> manager lock
         # (handle_message -> coordinate/create), so callbacks fired UNDER

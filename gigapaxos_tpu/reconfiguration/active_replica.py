@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..protocoltask import ProtocolExecutor, ProtocolTask
@@ -80,10 +81,22 @@ class ActiveReplica:
         my_id: int,
         coordinator: AbstractReplicaCoordinator,
         send: Callable[[Addr, str, Dict], None],
+        rc_ids: Optional[List[int]] = None,
     ):
         self.my_id = int(my_id)
         self.coordinator = coordinator
         self.send = send
+        # reconfigurator ids for Deactivator pause suggestions (any RC
+        # forwards to the name's primary); empty = no sweeps from here
+        self.rc_ids = list(rc_ids or [])
+        self._last_sweep = time.time()
+        # flag snapshots — tick runs every ~10ms and must not contend on
+        # the global Config lock
+        from ..paxos_config import PC
+        from ..utils.config import Config
+
+        self.pause_option = Config.get_bool(PC.PAUSE_OPTION)
+        self.deactivation_period_s = Config.get_float(PC.DEACTIVATION_PERIOD_S)
         self.tasks = ProtocolExecutor(
             send=lambda m: self.send(m[0], m[1], m[2])
         )
@@ -114,9 +127,36 @@ class ActiveReplica:
             )
         elif kind == "epoch_commit":
             self._handle_epoch_commit(body)
+        elif kind == "pause_epoch":
+            self._handle_pause_epoch(body)
 
     def tick(self, now: Optional[float] = None) -> None:
         self.tasks.tick(now)
+        self._maybe_sweep(now)
+
+    # ---- Deactivator sweep (PaxosManager.java:2931,2786) ---------------
+    def _maybe_sweep(self, now: Optional[float] = None) -> None:
+        if not self.rc_ids or not self.pause_option:
+            return
+        now = time.time() if now is None else now
+        period = self.deactivation_period_s
+        if now - self._last_sweep < period:
+            return
+        self._last_sweep = now
+        for name, epoch in self.coordinator.idle_groups(period):
+            rc = self.rc_ids[hash(name) % len(self.rc_ids)]
+            self.send(("RC", rc), "suggest_pause", {
+                "name": name, "epoch": epoch, "from": self.my_id,
+            })
+
+    # ---- pause (the RC-coordinated row free) ---------------------------
+    def _handle_pause_epoch(self, body: Dict) -> None:
+        name, epoch = body["name"], int(body["epoch"])
+        outcome = self.coordinator.pause_replica_group(name, epoch)
+        self.send(tuple(body["rc"]), "ack_pause_epoch", {
+            "name": name, "epoch": epoch, "from": self.my_id,
+            "ok": outcome in ("ok", "unknown"), "reason": outcome,
+        })
 
     # ---- start (handleStartEpoch, ActiveReplica.java:796) --------------
     def _handle_start_epoch(self, body: Dict) -> None:
@@ -162,11 +202,20 @@ class ActiveReplica:
             # until the RC's COMPLETE confirms the row via epoch_commit;
             # a late-start retransmit carries committed=True and creates
             # (or confirms) the group live
-            ok = self.coordinator.create_replica_group(
-                body["name"], int(body["epoch"]), list(body["actives"]),
-                state, row=int(body["row"]),
-                pending=not body.get("committed", False),
-            )
+            if body.get("resume"):
+                # reactivation after pause: restore from the local pause
+                # record / re-home a live row — same epoch, fresh row
+                ok = self.coordinator.resume_replica_group(
+                    body["name"], int(body["epoch"]), list(body["actives"]),
+                    int(body["row"]),
+                    pending=not body.get("committed", False),
+                )
+            else:
+                ok = self.coordinator.create_replica_group(
+                    body["name"], int(body["epoch"]), list(body["actives"]),
+                    state, row=int(body["row"]),
+                    pending=not body.get("committed", False),
+                )
             return "ok" if ok else "not-ready"
         except RuntimeError:
             return "collision"

@@ -61,6 +61,23 @@ class AbstractReplicaCoordinator:
     def delete_replica_group(self, name: str, epoch: int) -> bool:
         raise NotImplementedError
 
+    def pause_replica_group(self, name: str, epoch: int) -> str:
+        """Residency: free the group's engine row, snapshotting state for a
+        later resume.  Returns "ok" / "unknown" / "busy"."""
+        raise NotImplementedError
+
+    def resume_replica_group(
+        self, name: str, epoch: int, members: List[int], row: int,
+        pending: bool = True,
+    ) -> bool:
+        """Residency: reactivate at a freshly probed row (raises on a row
+        collision, like create)."""
+        raise NotImplementedError
+
+    def idle_groups(self, idle_s: float):
+        """(name, epoch) pairs idle long enough for a Deactivator sweep."""
+        raise NotImplementedError
+
     def get_replica_group(self, name: str) -> Optional[List[int]]:
         raise NotImplementedError
 
@@ -127,6 +144,20 @@ class PaxosReplicaCoordinator(AbstractReplicaCoordinator):
 
     def delete_replica_group(self, name: str, epoch: int) -> bool:
         return self.manager.kill_epoch(name, epoch)
+
+    def pause_replica_group(self, name: str, epoch: int) -> str:
+        return self.manager.pause_group(name, epoch)
+
+    def resume_replica_group(
+        self, name: str, epoch: int, members: List[int], row: int,
+        pending: bool = True,
+    ) -> bool:
+        return self.manager.resume_group(
+            name, epoch, members, row, pending=pending
+        )
+
+    def idle_groups(self, idle_s: float):
+        return self.manager.idle_names(idle_s)
 
     def get_replica_group(self, name: str) -> Optional[List[int]]:
         return self.manager.get_replica_group(name)

@@ -30,6 +30,9 @@ COMPLETE = "complete"                # majority of new actives up: -> READY
 DELETE_INTENT = "delete_intent"      # -> WAIT_DELETE
 DELETE_FINAL = "delete_final"        # purge record
 DROP_DONE = "drop_done"              # previous epoch's drop round finished
+PAUSE_INTENT = "pause_intent"        # residency: -> WAIT_PAUSE
+PAUSE_DONE = "pause_done"            # every active freed the row: -> PAUSED
+REACTIVATE = "reactivate"            # -> WAIT_ACK_START at a fresh row
 
 
 class RCRecordsApp(Replicable):
@@ -84,6 +87,12 @@ class RCRecordsApp(Replicable):
             if pde is None or int(op.get("epoch", -1)) != pde:
                 return False  # stale/duplicate drop confirmation
             return rec.drop_done()
+        if kind == PAUSE_INTENT:
+            return rec.start_pause()
+        if kind == PAUSE_DONE:
+            return rec.pause_done()
+        if kind == REACTIVATE:
+            return rec.start_reactivate(int(op["new_row"]))
         if kind == DELETE_INTENT:
             return rec.start_delete()
         if kind == DELETE_FINAL:

@@ -35,6 +35,9 @@ from .rc_app import (
     DELETE_FINAL,
     DELETE_INTENT,
     DROP_DONE,
+    PAUSE_DONE,
+    PAUSE_INTENT,
+    REACTIVATE,
     RECONFIGURE_INTENT,
     STOP_DONE,
     RCRecordsApp,
@@ -84,6 +87,7 @@ class StartEpochTask(ProtocolTask):
                     "initial_state": self.op.get("initial_state"),
                     "prev_actives": self.op.get("prev_actives") or [],
                     "prev_epoch": self.op.get("prev_epoch", -1),
+                    "resume": bool(self.op.get("resume")),
                     "rc": ["RC", self.rcf.my_id],
                 }))
         return out
@@ -116,7 +120,47 @@ class StartEpochTask(ProtocolTask):
                 "acked": sorted(self.acked),
                 "prev_actives": self.op.get("prev_actives") or [],
                 "prev_epoch": self.op.get("prev_epoch", -1),
+                "resume": bool(self.op.get("resume")),
             })
+        return ()
+
+
+class PauseEpochTask(ThresholdProtocolTask):
+    """Residency pause round: every active frees the group's row (all-ack
+    threshold — a row is only reusable on members that freed it, and the
+    collision NACK protects against partial pauses).  A busy NACK (traffic
+    resumed) cancels the pause by reactivating immediately."""
+
+    restart_period_s = 1.0
+    max_lifetime_s = 30.0
+
+    def __init__(self, key: str, rcf: "Reconfigurator", name: str,
+                 epoch: int, actives: List[int]):
+        super().__init__(key, actives, threshold=len(actives))
+        self.rcf = rcf
+        self.name = name
+        self.epoch = epoch
+
+    def send_to(self, node):
+        return (("AR", node), "pause_epoch", {
+            "name": self.name, "epoch": self.epoch,
+            "rc": ["RC", self.rcf.my_id],
+        })
+
+    def is_ack(self, kind, body):
+        if kind != "ack_pause_epoch" or body["name"] != self.name \
+                or int(body["epoch"]) != self.epoch:
+            return None
+        if not body.get("ok"):
+            # busy: the group saw traffic — cancel by reactivating (the
+            # members that already paused re-home via the resume round)
+            self.done = True
+            self.rcf.kick_reactivate(self.name)
+            return None
+        return int(body["from"])
+
+    def on_threshold(self):
+        self.rcf.propose_op({"op": PAUSE_DONE, "name": self.name})
         return ()
 
 
@@ -387,6 +431,12 @@ class Reconfigurator:
             self.tasks.handle_event(
                 f"commit:{body['name']}:{body.get('epoch')}", kind, body
             )
+        elif kind in ("ack_pause_epoch",):
+            self.tasks.handle_event(f"pause:{body['name']}", kind, body)
+        elif kind == "suggest_pause":
+            self._handle_suggest_pause(body)
+        elif kind == "reactivate_service":
+            self.kick_reactivate(body["name"])
 
     def tick(self, now: Optional[float] = None) -> None:
         self.tasks.tick(now)
@@ -471,7 +521,11 @@ class Reconfigurator:
                         reason="not-ready")
             return
         if rec.state is not RCState.READY:
-            if rec.new_actives == list(body["new_actives"]):
+            if rec.state in (RCState.PAUSED, RCState.WAIT_PAUSE):
+                # wake the record so the client's retry can succeed
+                self.kick_reactivate(name)
+            if rec.new_actives == list(body["new_actives"]) and \
+                    not rec.resuming:
                 # same migration already in flight: a client retransmit
                 # re-registers for the eventual COMPLETE reply
                 if body.get("client") is not None:
@@ -521,7 +575,11 @@ class Reconfigurator:
                 self._pending_clients[name] = body["client"]
             return
         if rec.state is not RCState.READY:
-            # mid-reconfiguration: DELETE_INTENT would be refused by the
+            if rec.state in (RCState.PAUSED, RCState.WAIT_PAUSE):
+                # a paused name must stay deletable: wake it so the
+                # client's delete retry finds it READY
+                self.kick_reactivate(name)
+            # mid-transition: DELETE_INTENT would be refused by the
             # record RSM and the client would never hear back — reply now
             self._reply(body, "delete_ack", name, ok=False, reason="not-ready")
             return
@@ -532,11 +590,48 @@ class Reconfigurator:
     # ---- reads (handleRequestActiveReplicas, :889) ---------------------
     def _handle_request_actives(self, body: Dict) -> None:
         rec = self.rc_app.get_record(body["name"])
+        if rec is not None and not rec.deleted and \
+                rec.state in (RCState.PAUSED, RCState.WAIT_PAUSE):
+            # a touch reactivates (message-triggered unpause analog,
+            # PaxosManager.java:2350); the client retries until READY
+            self.kick_reactivate(body["name"])
+            self._reply(body, "actives_response", body["name"], ok=False,
+                        reason="paused", actives=[], epoch=rec.epoch, row=-1)
+            return
         ok = rec is not None and not rec.deleted and bool(rec.actives)
         self._reply(body, "actives_response", body["name"], ok=ok,
                     actives=(rec.actives if ok else []),
                     epoch=(rec.epoch if ok else -1),
                     row=(rec.row if ok else -1))
+
+    # ---- residency (suggest_pause / reactivate) ------------------------
+    def _handle_suggest_pause(self, body: Dict) -> None:
+        name = body["name"]
+        if not self.is_primary(name):
+            self.send(("RC", self.rc_ring.get_node(name)), "suggest_pause", body)
+            return
+        rec = self.rc_app.get_record(name)
+        if rec is None or rec.deleted or rec.state is not RCState.READY:
+            return
+        if int(body.get("epoch", -1)) != rec.epoch:
+            return  # stale suggestion from a lagging active
+        self.propose_op({"op": PAUSE_INTENT, "name": name})
+
+    def kick_reactivate(self, name: str) -> None:
+        """Touch of a paused name: drive PAUSED/WAIT_PAUSE -> resume round
+        (forwarded to the record's primary)."""
+        if not self.is_primary(name):
+            self.send(("RC", self.rc_ring.get_node(name)),
+                      "reactivate_service", {"name": name})
+            return
+        rec = self.rc_app.get_record(name)
+        if rec is None or rec.deleted or \
+                rec.state not in (RCState.PAUSED, RCState.WAIT_PAUSE):
+            return
+        self.propose_op({
+            "op": REACTIVATE, "name": name,
+            "new_row": row_for(name, rec.epoch, 0, self.n_groups),
+        })
 
     def _bad_actives(self, actives) -> bool:
         return not actives or any(int(a) not in self.ar_ids for a in actives)
@@ -594,8 +689,18 @@ class Reconfigurator:
                         row=r.row,
                     ),
                 )
+            elif rec.state is RCState.WAIT_PAUSE:
+                self.tasks.spawn_if_not_running(
+                    f"pause:{name}",
+                    lambda n=name, r=rec: PauseEpochTask(
+                        f"pause:{n}", self, n, r.epoch, r.actives
+                    ),
+                )
             elif rec.state is RCState.WAIT_ACK_START:
-                if rec.actives:  # reconfiguration e -> e+1
+                if rec.resuming:  # reactivation at a fresh row, same epoch
+                    op = {"name": name, "epoch": rec.epoch,
+                          "actives": rec.new_actives, "resume": True}
+                elif rec.actives:  # reconfiguration e -> e+1
                     op = {"name": name, "epoch": rec.epoch + 1,
                           "actives": rec.new_actives,
                           "prev_actives": rec.actives,
@@ -740,6 +845,7 @@ class Reconfigurator:
                     "initial_state": rec.initial_state if was_create else None,
                     "prev_actives": op.get("prev_actives") or [],
                     "prev_epoch": int(op.get("prev_epoch", -1)),
+                    "resume": bool(op.get("resume")),
                     "rc": ["RC", self.my_id],
                     "committed": True,
                 }
@@ -751,6 +857,25 @@ class Reconfigurator:
                 )
             else:
                 spawn_prev_drop()
+        elif kind == PAUSE_INTENT:
+            assert rec is not None
+            self.tasks.spawn_if_not_running(
+                f"pause:{name}",
+                lambda: PauseEpochTask(
+                    f"pause:{name}", self, name, rec.epoch, rec.actives
+                ),
+            )
+        elif kind == REACTIVATE:
+            assert rec is not None
+            skey = f"start:{name}:{rec.epoch}"
+            self.tasks.spawn_if_not_running(
+                skey,
+                lambda: StartEpochTask(skey, self, {
+                    "name": name, "epoch": rec.epoch,
+                    "actives": rec.new_actives, "resume": True,
+                    "attempt": self._last_attempt.get(name, 0),
+                }),
+            )
         elif kind == DELETE_INTENT:
             assert rec is not None
             # stop the live epoch, then drop it everywhere, then purge the

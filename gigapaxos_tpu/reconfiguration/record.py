@@ -27,6 +27,11 @@ class RCState(str, enum.Enum):
     WAIT_ACK_STOP = "WAIT_ACK_STOP"
     WAIT_ACK_START = "WAIT_ACK_START"
     WAIT_DELETE = "WAIT_DELETE"
+    # residency (pause/unpause, PaxosManager.java:2264-2392 analog): the
+    # group's row is being freed / has been freed on its actives; a touch
+    # re-homes it at a freshly probed row via the start-epoch machinery
+    WAIT_PAUSE = "WAIT_PAUSE"
+    PAUSED = "PAUSED"
 
 
 @dataclass
@@ -48,6 +53,9 @@ class ReconfigurationRecord:
     # stopped rows forever; cleared by the DROP_DONE op
     pending_drop_epoch: Optional[int] = None
     pending_drop_actives: List[int] = field(default_factory=list)
+    # a reactivation start round keeps the SAME epoch (the group is not
+    # migrating, just re-homing to a fresh row after pause)
+    resuming: bool = False
 
     def to_json(self) -> Dict[str, Any]:
         return {
@@ -57,6 +65,7 @@ class ReconfigurationRecord:
             "initial_state": self.initial_state,
             "pending_drop_epoch": self.pending_drop_epoch,
             "pending_drop_actives": self.pending_drop_actives,
+            "resuming": self.resuming,
         }
 
     @classmethod
@@ -69,6 +78,7 @@ class ReconfigurationRecord:
             initial_state=d.get("initial_state"),
             pending_drop_epoch=d.get("pending_drop_epoch"),
             pending_drop_actives=list(d.get("pending_drop_actives") or []),
+            resuming=bool(d.get("resuming", False)),
         )
 
     # ---- transitions (setState analog, ReconfigurationRecord.java:466+) --
@@ -94,7 +104,7 @@ class ReconfigurationRecord:
         stays as born; for a reconfiguration it advances e -> e+1."""
         if self.state is not RCState.WAIT_ACK_START:
             return False
-        if self.actives:
+        if self.actives and not self.resuming:
             # the outgoing epoch owes a drop round on its old actives
             self.pending_drop_epoch = self.epoch
             self.pending_drop_actives = list(self.actives)
@@ -103,7 +113,34 @@ class ReconfigurationRecord:
         self.row = self.new_row
         self.new_actives = []
         self.new_row = -1
+        self.resuming = False
         self.state = RCState.READY
+        return True
+
+    # ---- residency (pause/unpause, §3.4 analog) -----------------------
+    def start_pause(self) -> bool:
+        """READY -> WAIT_PAUSE: free the row on every active."""
+        if self.state is not RCState.READY or self.deleted:
+            return False
+        self.state = RCState.WAIT_PAUSE
+        return True
+
+    def pause_done(self) -> bool:
+        if self.state is not RCState.WAIT_PAUSE:
+            return False
+        self.state = RCState.PAUSED
+        self.row = -1
+        return True
+
+    def start_reactivate(self, new_row: int) -> bool:
+        """PAUSED/WAIT_PAUSE -> WAIT_ACK_START at a fresh row, same epoch
+        (also serves as the cancel path for a half-completed pause)."""
+        if self.state not in (RCState.PAUSED, RCState.WAIT_PAUSE) or self.deleted:
+            return False
+        self.new_actives = list(self.actives)
+        self.new_row = int(new_row)
+        self.resuming = True
+        self.state = RCState.WAIT_ACK_START
         return True
 
     def drop_done(self) -> bool:

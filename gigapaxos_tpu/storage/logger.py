@@ -38,6 +38,8 @@ class RecoveredState:
         payloads: Dict[int, str],
         names: Dict[str, Dict[str, Any]],
         pending_rows: Optional[set] = None,
+        pause_records: Optional[Dict] = None,
+        decisions: Optional[Dict[int, Dict[int, int]]] = None,
     ):
         self.arrays = arrays          # None => fresh start
         self.meta = meta
@@ -48,6 +50,16 @@ class RecoveredState:
         # rows still awaiting the reconfigurator's epoch_commit (the
         # propose-refusal gate survives a restart)
         self.pending_rows = pending_rows or set()
+        # (name, epoch) -> last pause record (still-paused groups resume
+        # from these; resumed groups fold them under replayed progress)
+        self.pause_records = pause_records or {}
+        # group -> {slot -> vid}: EVERY journaled decision after the
+        # checkpoint.  The [G, W] rings only retain the last W decisions
+        # per group (lane reuse), so a group that decided more than W slots
+        # since its last checkpoint can only roll forward through these.
+        self.decisions = decisions or {}
+        # vid -> (entry_replica, request_id) journaled alongside payloads
+        self.payload_meta: Dict[int, Tuple[int, int]] = {}
 
 
 class PaxosLogger:
@@ -104,13 +116,32 @@ class PaxosLogger:
         if len(groups):
             self.journal.append_columns(BlockType.UNPEND, [groups])
 
+    def log_pause(self, record: Dict[str, Any]) -> None:
+        """Residency pause record: the group's consensus/app snapshot at
+        the moment its row was freed (HotRestoreInfo -> pause table analog,
+        ``PaxosManager.java:2307-2348``).  JSON — the window remnants are a
+        handful of ints and the app state is a string."""
+        self.journal.append(
+            BlockType.PAUSE,
+            json.dumps(record, separators=(",", ":")).encode("utf-8"),
+        )
+
     def log_kill(self, groups) -> None:
         if len(groups):
             self.journal.append_columns(BlockType.KILL, [groups])
 
-    def log_payloads(self, payloads: Dict[int, str]) -> None:
+    def log_payloads(
+        self, payloads: Dict[int, str], meta: Optional[Dict] = None
+    ) -> None:
+        """Persist request payloads (and their (entry, request_id) meta so
+        exactly-once dedup survives a restart).  Every replica journals
+        payloads it learns — locally admitted AND peer-replicated — or a
+        coordinator-only crash could lose decided-but-unexecuted values."""
         if payloads:
-            body = json.dumps(payloads, separators=(",", ":")).encode("utf-8")
+            env = {"p": payloads}
+            if meta:
+                env["m"] = {str(k): list(v) for k, v in meta.items()}
+            body = json.dumps(env, separators=(",", ":")).encode("utf-8")
             self.journal.append(BlockType.PAYLOADS, body)
 
     # ---- checkpoint ----------------------------------------------------
@@ -156,11 +187,39 @@ class PaxosLogger:
         # chronological pending-row tracking: checkpoint seed, then NAMES
         # adds (pending creates), UNPEND/KILL clears, in scan order
         pending: set = set(int(r) for r in meta.get("pending_rows") or [])
+        pause_records: Dict[Any, Dict[str, Any]] = {
+            (str(r["name"]), int(r["epoch"])): r
+            for r in (meta.get("paused") or {}).values()
+        }
+        decisions: Dict[int, Dict[int, int]] = {}
+        payload_meta: Dict[int, Tuple[int, int]] = {}
         for btype, payload, n_rows, _pos in self.journal.scan(from_file, from_off):
-            if btype == BlockType.PAYLOADS:
-                payloads.update(
-                    {int(k): v for k, v in json.loads(payload.decode("utf-8")).items()}
+            if btype == BlockType.PAUSE:
+                rec = json.loads(payload.decode("utf-8"))
+                key = (str(rec["name"]), int(rec["epoch"]))
+                if rec.get("dropped"):
+                    pause_records.pop(key, None)  # deleted-while-paused
+                else:
+                    pause_records[key] = rec
+                continue
+            if btype == BlockType.DECISIONS:
+                m = Journal.columns(payload, n_rows, 3)
+                for g_, slot_, vid_ in m:
+                    decisions.setdefault(int(g_), {})[int(slot_)] = int(vid_)
+            elif btype in (BlockType.KILL, BlockType.CREATE):
+                m = Journal.columns(
+                    payload, n_rows, 1 if btype == BlockType.KILL else 4
                 )
+                for g_ in m[:, 0]:
+                    decisions.pop(int(g_), None)  # row reused: old log void
+            if btype == BlockType.PAYLOADS:
+                env = json.loads(payload.decode("utf-8"))
+                # pre-envelope journals stored the flat {vid: payload} map
+                # ("p" can't collide: real keys are numeric strings)
+                flat = env["p"] if "p" in env else env
+                payloads.update({int(k): v for k, v in flat.items()})
+                for k, m_ in (env.get("m") or {}).items():
+                    payload_meta[int(k)] = (int(m_[0]), int(m_[1]))
                 continue
             if btype == BlockType.NAMES:
                 for ent in json.loads(payload.decode("utf-8")):
@@ -186,7 +245,11 @@ class PaxosLogger:
                     )
                 arrays = {k: v.copy() for k, v in seed_arrays.items()}
             self._apply(arrays, btype, payload, n_rows, window, my_id)
-        return RecoveredState(arrays, meta, payloads, names, pending)
+        out = RecoveredState(
+            arrays, meta, payloads, names, pending, pause_records, decisions
+        )
+        out.payload_meta = payload_meta
+        return out
 
     @staticmethod
     def _apply(

@@ -50,7 +50,7 @@ class ReconfigurableCluster:
             mgr = self.ars.managers[i]
             coord = PaxosReplicaCoordinator(mgr.app, mgr)
             self.active_replicas.append(
-                ActiveReplica(i, coord, self._sender())
+                ActiveReplica(i, coord, self._sender(), rc_ids=self.rc_ids)
             )
         self.reconfigurators: List[Reconfigurator] = []
         for j in self.rc_ids:
