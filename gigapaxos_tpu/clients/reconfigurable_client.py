@@ -133,6 +133,21 @@ class ReconfigurableAppClient(AsyncFrameClient):
         self.invalidate(name)
         return ack
 
+    def add_active(self, node_id: int, timeout: float = 10.0) -> Optional[Dict]:
+        """Elastic membership: admit a new active node (its address must
+        already be in the cluster's address books)."""
+        return self._rc_op_sync(
+            "add_active", "add_active_ack", str(node_id),
+            {"id": int(node_id)}, timeout,
+        )
+
+    def remove_active(self, node_id: int, timeout: float = 10.0) -> Optional[Dict]:
+        """Elastic membership: retire an active; its groups migrate off."""
+        return self._rc_op_sync(
+            "remove_active", "remove_active_ack", str(node_id),
+            {"id": int(node_id)}, timeout,
+        )
+
     def reconfigure(
         self, name: str, new_actives: List[int], timeout: float = 15.0
     ) -> Optional[Dict]:

@@ -166,6 +166,10 @@ class PaxosManager:
         # freed for reuse; reactivation restores at a freshly probed row
         self.paused: Dict[Tuple[str, int], Dict] = {}
         self.row_activity = np.zeros(G, np.float64)  # wall time of last use
+        # per-name arriving-request counts since the last demand report
+        # (updateDemandStats analog; drained by the ActiveReplica layer)
+        self.demand_counts: Dict[str, int] = {}
+        self.demand_backlog = 0  # total unreported requests (flush trigger)
         self.arena: Dict[int, str] = {}        # vid -> request payload (json str)
         self.vid_meta: Dict[int, Tuple[int, int]] = {}  # vid -> (entry_replica, request_id)
         self.outstanding = Outstanding()
@@ -733,6 +737,20 @@ class PaxosManager:
             self.row_activity[r] = time.time()
             return True
 
+    def drain_demand(self) -> Dict[str, Tuple[int, int]]:
+        """Take the per-name request counts since the last drain; returns
+        {name: (count, epoch)} for current-epoch names."""
+        with self._state_lock:
+            counts, self.demand_counts = self.demand_counts, {}
+            self.demand_backlog = 0
+            versions = np.asarray(self.state.version)
+            out = {}
+            for name, n in counts.items():
+                row = self.names.get(name)
+                if row is not None:
+                    out[name] = (n, int(versions[row]))
+            return out
+
     def idle_names(self, idle_s: float) -> List[Tuple[str, int]]:
         """(name, epoch) of current-epoch groups with no traffic for
         `idle_s` seconds (Deactivator sweep candidates)."""
@@ -837,6 +855,8 @@ class PaxosManager:
                     self.outstanding.put(request_id, callback)
                 self.queues.setdefault(row, []).append(vid)
                 self.row_activity[row] = time.time()
+                self.demand_counts[name] = self.demand_counts.get(name, 0) + 1
+                self.demand_backlog += 1
         if cached_hit:
             if callback:
                 callback(request_id, cached_response)

@@ -45,6 +45,7 @@ from .utils.config import Config
 # reconfigurator-plane kinds a client may send to an RC
 RC_CLIENT_KINDS = (
     "create_service", "delete_service", "reconfigure", "request_actives",
+    "add_active", "remove_active",
 )
 
 
@@ -145,6 +146,7 @@ class ReconfiguratorServer(PaxosServer):
             my_id, self.manager, self.rc_app, ar_ids, rc_ids,
             _EpochSender(self, ar_nodes, rc_nodes),
             ar_n_groups=ar_cfg.n_groups,
+            is_node_up=self.fd.is_node_up,
         )
         # LOCK ORDER (see ActiveReplicaServer): on_applied fires inside
         # manager.tick under the manager lock — queue and drain at tick.

@@ -97,6 +97,13 @@ class ActiveReplica:
 
         self.pause_option = Config.get_bool(PC.PAUSE_OPTION)
         self.deactivation_period_s = Config.get_float(PC.DEACTIVATION_PERIOD_S)
+        from .rc_config import RC
+
+        self.demand_report_period_s = Config.get_float(
+            RC.DEMAND_REPORT_PERIOD_S
+        )
+        self.demand_report_every = Config.get_int(RC.DEMAND_REPORT_EVERY)
+        self._last_demand_flush = time.time()
         self.tasks = ProtocolExecutor(
             send=lambda m: self.send(m[0], m[1], m[2])
         )
@@ -133,6 +140,26 @@ class ActiveReplica:
     def tick(self, now: Optional[float] = None) -> None:
         self.tasks.tick(now)
         self._maybe_sweep(now)
+        self._maybe_report_demand(now)
+
+    # ---- demand reporting (updateDemandStats -> DemandReport,
+    # ActiveReplica demand hooks / DemandReport.java) --------------------
+    def _maybe_report_demand(self, now: Optional[float] = None) -> None:
+        if not self.rc_ids:
+            return
+        now = time.time() if now is None else now
+        # flush on period OR when the unreported backlog crosses the count
+        # threshold (a hot name must not wait out the period)
+        if now - self._last_demand_flush < self.demand_report_period_s and \
+                self.coordinator.demand_backlog() < self.demand_report_every:
+            return
+        self._last_demand_flush = now
+        for name, (count, epoch) in self.coordinator.drain_demand().items():
+            self.send(("RC", self.rc_ids[hash(name) % len(self.rc_ids)]),
+                      "demand_report", {
+                          "name": name, "epoch": epoch,
+                          "count": count, "from": self.my_id,
+                      })
 
     # ---- Deactivator sweep (PaxosManager.java:2931,2786) ---------------
     def _maybe_sweep(self, now: Optional[float] = None) -> None:

@@ -78,6 +78,15 @@ class AbstractReplicaCoordinator:
         """(name, epoch) pairs idle long enough for a Deactivator sweep."""
         raise NotImplementedError
 
+    def drain_demand(self):
+        """{name: (request count since last drain, epoch)} for demand
+        reporting (updateDemandStats analog)."""
+        raise NotImplementedError
+
+    def demand_backlog(self) -> int:
+        """Total unreported request count (early-flush trigger)."""
+        raise NotImplementedError
+
     def get_replica_group(self, name: str) -> Optional[List[int]]:
         raise NotImplementedError
 
@@ -158,6 +167,12 @@ class PaxosReplicaCoordinator(AbstractReplicaCoordinator):
 
     def idle_groups(self, idle_s: float):
         return self.manager.idle_names(idle_s)
+
+    def drain_demand(self):
+        return self.manager.drain_demand()
+
+    def demand_backlog(self) -> int:
+        return self.manager.demand_backlog
 
     def get_replica_group(self, name: str) -> Optional[List[int]]:
         return self.manager.get_replica_group(name)

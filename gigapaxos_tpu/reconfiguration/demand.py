@@ -1,0 +1,116 @@
+"""Demand-driven reconfiguration: profiles + aggregation.
+
+API-parity target: ``AbstractDemandProfile``
+(``reconfigurationutils/AbstractDemandProfile.java:103-149`` —
+``shouldReportDemandStats`` / ``getStats`` / ``combine`` / ``reconfigure``
+/ ``justReconfigured``), the default ``DemandProfile`` (rate/#requests,
+never moves the group), and ``AggregateDemandProfiler`` (per-name
+aggregation with clipping).  Actives count arriving requests and ship
+:data:`DemandReport`-shaped dicts to the name's primary reconfigurator,
+whose profile instance decides whether to migrate
+(``Reconfigurator.handleDemandReport``, ``Reconfigurator.java:311``).
+
+Profiles are pluggable by dotted path (``RC.DEMAND_PROFILE_TYPE``,
+``DEMAND_PROFILE_TYPE`` analog) so deployments can implement locality
+policies (the reference ships a GeoIP example).
+"""
+
+from __future__ import annotations
+
+import importlib
+import time
+from typing import Dict, List, Optional
+
+from ..utils.config import Config
+from .rc_config import RC
+
+
+class AbstractDemandProfile:
+    """Per-name demand state living at the record's primary RC."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def combine(self, report: Dict) -> None:
+        """Fold one active's report into the aggregate."""
+        raise NotImplementedError
+
+    def reconfigure(
+        self, cur_actives: List[int], all_actives: List[int]
+    ) -> Optional[List[int]]:
+        """Return a new replica set, or None to stay put."""
+        raise NotImplementedError
+
+    def just_reconfigured(self) -> None:
+        """Reset after a migration this profile triggered."""
+        raise NotImplementedError
+
+
+class DemandProfile(AbstractDemandProfile):
+    """Reference-default behavior (``DemandProfile.java``): track request
+    totals and an EWMA arrival rate; never propose a move."""
+
+    RATE_WINDOW_S = 1.0  # EWMA update granularity
+
+    def __init__(self, name: str):
+        super().__init__(name)
+        self.num_requests = 0
+        self.num_total = 0
+        self.rate = 0.0          # requests/s EWMA
+        self.last_ts = time.time()
+        self._win_count = 0      # requests in the open window
+        self.by_active: Dict[int, int] = {}
+
+    def combine(self, report: Dict) -> None:
+        n = int(report.get("count", 0))
+        self.num_requests += n
+        self.num_total += n
+        src = int(report.get("from", -1))
+        self.by_active[src] = self.by_active.get(src, 0) + n
+        # windowed EWMA: near-simultaneous reports from several actives
+        # accumulate into one window — folding each against the tiny
+        # inter-report gap would inflate the rate by orders of magnitude
+        self._win_count += n
+        now = time.time()
+        dt = now - self.last_ts
+        if dt >= self.RATE_WINDOW_S:
+            self.rate = 0.8 * self.rate + 0.2 * (self._win_count / dt)
+            self._win_count = 0
+            self.last_ts = now
+
+    def reconfigure(self, cur_actives, all_actives):
+        return None  # the default profile only measures
+
+    def just_reconfigured(self) -> None:
+        self.num_requests = 0
+        self.by_active.clear()
+
+
+class AggregateDemandProfiler:
+    """Per-name profile table with clipping
+    (``AggregateDemandProfiler.java`` analog)."""
+
+    MAX_NAMES = 100_000
+
+    def __init__(self, profile_cls=None):
+        if profile_cls is None:
+            path = Config.get_str(RC.DEMAND_PROFILE_TYPE)
+            mod, _, cls = path.rpartition(".")
+            profile_cls = getattr(importlib.import_module(mod), cls)
+        self.profile_cls = profile_cls
+        self._profiles: Dict[str, AbstractDemandProfile] = {}
+
+    def combine(self, name: str, report: Dict) -> AbstractDemandProfile:
+        prof = self._profiles.get(name)
+        if prof is None:
+            if len(self._profiles) >= self.MAX_NAMES:
+                # clip: drop an arbitrary cold entry (the reference clips
+                # by pushing out aggregated entries)
+                self._profiles.pop(next(iter(self._profiles)))
+            prof = self.profile_cls(name)
+            self._profiles[name] = prof
+        prof.combine(report)
+        return prof
+
+    def pop(self, name: str) -> None:
+        self._profiles.pop(name, None)

@@ -33,6 +33,8 @@ DROP_DONE = "drop_done"              # previous epoch's drop round finished
 PAUSE_INTENT = "pause_intent"        # residency: -> WAIT_PAUSE
 PAUSE_DONE = "pause_done"            # every active freed the row: -> PAUSED
 REACTIVATE = "reactivate"            # -> WAIT_ACK_START at a fresh row
+AR_ADD = "ar_add"                    # elastic membership: add an active
+AR_REMOVE = "ar_remove"              # elastic membership: remove an active
 
 
 class RCRecordsApp(Replicable):
@@ -41,6 +43,10 @@ class RCRecordsApp(Replicable):
     def __init__(self, on_applied: Optional[Callable[[Dict], None]] = None):
         self.records: Dict[str, ReconfigurationRecord] = {}
         self.on_applied = on_applied
+        # elastic membership: the replicated active-node set (AR_NODES
+        # record analog, AbstractReconfiguratorDB.java:84-96); None means
+        # "as configured at boot"
+        self.ar_nodes: Optional[list] = None
 
     # ---- Replicable ----------------------------------------------------
     def execute(self, request: Request, do_not_reply_to_client: bool = False) -> bool:
@@ -54,7 +60,22 @@ class RCRecordsApp(Replicable):
         return True
 
     def _apply(self, op: Dict) -> bool:
-        kind, name = op["op"], op["name"]
+        kind = op["op"]
+        if kind in (AR_ADD, AR_REMOVE):
+            nid = int(op["id"])
+            cur = list(self.ar_nodes if self.ar_nodes is not None
+                       else op.get("boot_actives") or [])
+            if kind == AR_ADD:
+                if nid in cur:
+                    return False
+                cur.append(nid)
+            else:
+                if nid not in cur or len(cur) <= 1:
+                    return False  # never remove the last active
+                cur.remove(nid)
+            self.ar_nodes = sorted(cur)
+            return True
+        name = op["name"]
         rec = self.records.get(name)
         if kind == CREATE_INTENT:
             if rec is not None and not rec.deleted:
@@ -92,7 +113,9 @@ class RCRecordsApp(Replicable):
         if kind == PAUSE_DONE:
             return rec.pause_done()
         if kind == REACTIVATE:
-            return rec.start_reactivate(int(op["new_row"]))
+            return rec.start_reactivate(
+                int(op["new_row"]), actives=op.get("actives")
+            )
         if kind == DELETE_INTENT:
             return rec.start_delete()
         if kind == DELETE_FINAL:
@@ -105,13 +128,28 @@ class RCRecordsApp(Replicable):
     def checkpoint(self, name: str) -> Optional[str]:
         # the whole record map is ONE RSM (one paxos group among the RCs),
         # so the checkpoint is the full map regardless of `name`
-        return json.dumps({n: r.to_json() for n, r in self.records.items()})
+        return json.dumps({
+            "records": {n: r.to_json() for n, r in self.records.items()},
+            "ar_nodes": self.ar_nodes,
+        })
 
     def restore(self, name: str, state: Optional[str]) -> bool:
-        self.records = {} if not state else {
-            n: ReconfigurationRecord.from_json(d)
-            for n, d in json.loads(state).items()
+        if not state:
+            self.records = {}
+            self.ar_nodes = None
+            return True
+        d = json.loads(state)
+        # new format iff BOTH envelope keys exist and "records" isn't
+        # itself a record (a service literally named "records" in an old
+        # flat-map checkpoint would otherwise be misparsed)
+        if not ("records" in d and "ar_nodes" in d
+                and "name" not in (d["records"] or {})):
+            d = {"records": d, "ar_nodes": None}
+        self.records = {
+            n: ReconfigurationRecord.from_json(r)
+            for n, r in d["records"].items()
         }
+        self.ar_nodes = d.get("ar_nodes")
         return True
 
     # ---- reads (RequestActiveReplicas analog) --------------------------

@@ -25,6 +25,8 @@ class RC(enum.Enum):
     )
     # actives report aggregated demand to the RC every this many requests
     DEMAND_REPORT_EVERY = 64
+    # ...and at least this often while any demand is unreported
+    DEMAND_REPORT_PERIOD_S = 1.0
 
     # ---- task re-drive machinery (TPU-build specific) ------------------
     REDRIVE_EVERY = 32          # reconfigurator ticks between record scans

@@ -30,6 +30,8 @@ from ..utils.config import Config
 from .chash import ConsistentHashing
 from .rc_config import RC
 from .rc_app import (
+    AR_ADD,
+    AR_REMOVE,
     COMPLETE,
     CREATE_INTENT,
     DELETE_FINAL,
@@ -350,6 +352,8 @@ class Reconfigurator:
         send: Callable[[Addr, str, Dict], None],
         default_replicas: Optional[int] = None,  # None -> RC.DEFAULT_NUM_REPLICAS
         ar_n_groups: Optional[int] = None,       # row space of the AR engine
+        is_node_up: Optional[Callable[[int], bool]] = None,  # RC liveness
+        demand_profiler=None,  # AggregateDemandProfiler override (tests)
     ):
         self.my_id = int(my_id)
         self.rc_manager = rc_manager
@@ -366,9 +370,23 @@ class Reconfigurator:
         )
         self.REDRIVE_EVERY = Config.get_int(RC.REDRIVE_EVERY)
         self.MAX_REDROPS = Config.get_int(RC.MAX_REDROPS)
-        self.ar_ids = set(int(a) for a in actives)
-        self.ar_ring = ConsistentHashing(actives)
+        # elastic membership: the replicated AR set (rc_app.ar_nodes) wins
+        # over the boot configuration once any add/remove has committed
+        self._boot_actives = [int(a) for a in actives]
+        live = (rc_app.ar_nodes if rc_app.ar_nodes is not None
+                else self._boot_actives)
+        self.ar_ids = set(int(a) for a in live)
+        self.ar_ring = ConsistentHashing(sorted(self.ar_ids))
         self.rc_ring = ConsistentHashing(reconfigurators)
+        # RC-peer liveness for primary takeover (default: all alive)
+        self.is_node_up = is_node_up or (lambda _rc: True)
+        # demand aggregation at the record's primary (handleDemandReport)
+        from .demand import AggregateDemandProfiler
+
+        self.demand = (
+            AggregateDemandProfiler() if demand_profiler is None
+            else demand_profiler
+        )
         self.tasks = ProtocolExecutor(send=lambda m: self.send(m[0], m[1], m[2]))
         # client replies owed on COMPLETE / DELETE_FINAL: name -> client addr
         self._pending_clients: Dict[str, Any] = {}
@@ -387,10 +405,23 @@ class Reconfigurator:
         rc_app.on_applied = self._on_applied
 
     # ------------------------------------------------------------------
+    def primary_of(self, name: str) -> int:
+        """Effective record owner: the first LIVE reconfigurator on the
+        name's ring (WaitPrimaryExecution analog,
+        ``WaitPrimaryExecution.java:60`` — a secondary takes over a dead
+        primary's pending reconfigurations).  Liveness comes from the
+        injected ``is_node_up`` hook (the RC cluster's failure detector);
+        the default considers everyone alive (= static ring primary)."""
+        order = self.rc_ring.get_replicated_servers(
+            name, len(self.rc_ring.nodes)
+        )
+        for rc in order:
+            if rc == self.my_id or self.is_node_up(rc):
+                return rc
+        return order[0] if order else self.my_id
+
     def is_primary(self, name: str) -> bool:
-        """Record owner = first RC on the ring (WaitPrimaryExecution's
-        primary; secondary takeover is a failure-handling extension)."""
-        return self.rc_ring.get_node(name) == self.my_id
+        return self.primary_of(name) == self.my_id
 
     def propose_op(self, op: Dict) -> None:
         """Commit an RC-record mutation through the RC paxos group
@@ -437,6 +468,10 @@ class Reconfigurator:
             self._handle_suggest_pause(body)
         elif kind == "reactivate_service":
             self.kick_reactivate(body["name"])
+        elif kind == "demand_report":
+            self._handle_demand_report(body)
+        elif kind in ("add_active", "remove_active"):
+            self._handle_membership(kind, body)
 
     def tick(self, now: Optional[float] = None) -> None:
         self.tasks.tick(now)
@@ -482,7 +517,7 @@ class Reconfigurator:
         name = body["name"]
         if not self.is_primary(name):
             # forward to the owner (the reference redirects via the ring)
-            self.send(("RC", self.rc_ring.get_node(name)), "create_service", body)
+            self.send(("RC", self.primary_of(name)), "create_service", body)
             return
         rec = self.rc_app.get_record(name)
         if rec is not None and not rec.deleted:
@@ -513,7 +548,7 @@ class Reconfigurator:
     def _handle_reconfigure(self, body: Dict) -> None:
         name = body["name"]
         if not self.is_primary(name):
-            self.send(("RC", self.rc_ring.get_node(name)), "reconfigure", body)
+            self.send(("RC", self.primary_of(name)), "reconfigure", body)
             return
         rec = self.rc_app.get_record(name)
         if rec is None or rec.deleted:
@@ -562,7 +597,7 @@ class Reconfigurator:
     def _handle_delete(self, body: Dict) -> None:
         name = body["name"]
         if not self.is_primary(name):
-            self.send(("RC", self.rc_ring.get_node(name)), "delete_service", body)
+            self.send(("RC", self.primary_of(name)), "delete_service", body)
             return
         rec = self.rc_app.get_record(name)
         if rec is None or rec.deleted:
@@ -604,11 +639,75 @@ class Reconfigurator:
                     epoch=(rec.epoch if ok else -1),
                     row=(rec.row if ok else -1))
 
+    # ---- elastic membership (handleReconfigureActiveNodeConfig,
+    # Reconfigurator.java:1023-1075) -------------------------------------
+    def _handle_membership(self, kind: str, body: Dict) -> None:
+        nid = int(body["id"])
+        already = (nid in self.ar_ids) == (kind == "add_active")
+        if already:
+            # idempotent retransmit: the op already took effect (possibly
+            # via this client's earlier attempt) — a duplicate proposal
+            # would apply False and mislead the operator with ok=False
+            self._reply(body, f"{kind}_ack", str(nid), id=nid, ok=True,
+                        actives=sorted(self.ar_ids), already=True)
+            return
+        if body.get("client") is not None:
+            self._pending_clients[f"#m:{kind}:{nid}"] = body["client"]
+        self.propose_op({
+            "op": AR_ADD if kind == "add_active" else AR_REMOVE,
+            "id": nid,
+            "boot_actives": sorted(self.ar_ids),
+        })
+
+    def _refresh_ar_ring(self) -> None:
+        live = (self.rc_app.ar_nodes if self.rc_app.ar_nodes is not None
+                else self._boot_actives)
+        self.ar_ids = set(int(a) for a in live)
+        self.ar_ring = ConsistentHashing(sorted(self.ar_ids))
+
+    def _rehome_set(self, name: str, actives: List[int]) -> List[int]:
+        """Replacement set after membership loss: keep surviving members,
+        fill from the refreshed ring (capped by availability)."""
+        keep = [a for a in actives if a in self.ar_ids]
+        want = min(len(actives), len(self.ar_ids))
+        for cand in self.ar_ring.get_replicated_servers(
+            name, min(want, len(self.ar_ids))
+        ):
+            if len(keep) >= want:
+                break
+            if cand not in keep:
+                keep.append(cand)
+        return keep
+
+    # ---- demand (handleDemandReport, Reconfigurator.java:311) ----------
+    def _handle_demand_report(self, body: Dict) -> None:
+        name = body["name"]
+        if not self.is_primary(name):
+            self.send(("RC", self.primary_of(name)), "demand_report", body)
+            return
+        rec = self.rc_app.get_record(name)
+        if rec is None or rec.deleted:
+            self.demand.pop(name)
+            return
+        prof = self.demand.combine(name, body)
+        if rec.state is not RCState.READY:
+            return
+        target = prof.reconfigure(list(rec.actives), sorted(self.ar_ids))
+        if not target or sorted(target) == sorted(rec.actives) or \
+                self._bad_actives(target):
+            return
+        prof.just_reconfigured()
+        self.propose_op({
+            "op": RECONFIGURE_INTENT, "name": name,
+            "new_actives": list(target),
+            "new_row": row_for(name, rec.epoch + 1, 0, self.n_groups),
+        })
+
     # ---- residency (suggest_pause / reactivate) ------------------------
     def _handle_suggest_pause(self, body: Dict) -> None:
         name = body["name"]
         if not self.is_primary(name):
-            self.send(("RC", self.rc_ring.get_node(name)), "suggest_pause", body)
+            self.send(("RC", self.primary_of(name)), "suggest_pause", body)
             return
         rec = self.rc_app.get_record(name)
         if rec is None or rec.deleted or rec.state is not RCState.READY:
@@ -621,16 +720,20 @@ class Reconfigurator:
         """Touch of a paused name: drive PAUSED/WAIT_PAUSE -> resume round
         (forwarded to the record's primary)."""
         if not self.is_primary(name):
-            self.send(("RC", self.rc_ring.get_node(name)),
+            self.send(("RC", self.primary_of(name)),
                       "reactivate_service", {"name": name})
             return
         rec = self.rc_app.get_record(name)
         if rec is None or rec.deleted or \
                 rec.state not in (RCState.PAUSED, RCState.WAIT_PAUSE):
             return
+        live = [a for a in rec.actives if a in self.ar_ids]
         self.propose_op({
             "op": REACTIVATE, "name": name,
             "new_row": row_for(name, rec.epoch, 0, self.n_groups),
+            # resume only on members still in the cluster (the READY
+            # re-home scan grows the set back afterwards if short)
+            "actives": live or None,
         })
 
     def _bad_actives(self, actives) -> bool:
@@ -651,6 +754,20 @@ class Reconfigurator:
             if rec.deleted or not self.is_primary(name):
                 continue
             if rec.state is RCState.READY:
+                lost = [a for a in rec.actives if a not in self.ar_ids]
+                if lost:
+                    # a member left the cluster: migrate the group off it
+                    # (ring-refresh re-homing, Reconfigurator.java:1075)
+                    target = self._rehome_set(name, rec.actives)
+                    if target and sorted(target) != sorted(rec.actives):
+                        self.propose_op({
+                            "op": RECONFIGURE_INTENT, "name": name,
+                            "new_actives": target,
+                            "new_row": row_for(
+                                name, rec.epoch + 1, 0, self.n_groups
+                            ),
+                        })
+                        continue
                 if (name, rec.epoch) not in self._commit_done:
                     ckey = f"commit:{name}:{rec.epoch}"
                     self.tasks.spawn_if_not_running(
@@ -690,10 +807,15 @@ class Reconfigurator:
                     ),
                 )
             elif rec.state is RCState.WAIT_PAUSE:
+                # target only members still in the cluster: a removed node
+                # can never ack and would wedge the all-ack round forever
+                live = [a for a in rec.actives if a in self.ar_ids]
+                if not live:
+                    continue
                 self.tasks.spawn_if_not_running(
                     f"pause:{name}",
-                    lambda n=name, r=rec: PauseEpochTask(
-                        f"pause:{n}", self, n, r.epoch, r.actives
+                    lambda n=name, r=rec, lv=live: PauseEpochTask(
+                        f"pause:{n}", self, n, r.epoch, lv
                     ),
                 )
             elif rec.state is RCState.WAIT_ACK_START:
@@ -754,6 +876,23 @@ class Reconfigurator:
     def _on_applied(self, op: Dict) -> None:
         """Fires on EVERY reconfigurator when an RC-record op executes;
         only the record's primary drives the next protocol step."""
+        if op["op"] in (AR_ADD, AR_REMOVE):
+            # membership ops affect every RC: refresh the ring, answer the
+            # client wherever it registered; affected names migrate off a
+            # removed node via the READY re-drive scan
+            if op.get("applied"):
+                self._refresh_ar_ring()
+            kind = "add_active" if op["op"] == AR_ADD else "remove_active"
+            client = self._pending_clients.pop(
+                f"#m:{kind}:{int(op['id'])}", None
+            )
+            if client is not None:
+                self.send(tuple(client), f"{kind}_ack", {
+                    "id": int(op["id"]), "name": str(op["id"]),
+                    "ok": bool(op.get("applied")),
+                    "actives": sorted(self.ar_ids),
+                })
+            return
         name = op["name"]
         if not op.get("applied") or not self.is_primary(name):
             return
@@ -859,12 +998,14 @@ class Reconfigurator:
                 spawn_prev_drop()
         elif kind == PAUSE_INTENT:
             assert rec is not None
-            self.tasks.spawn_if_not_running(
-                f"pause:{name}",
-                lambda: PauseEpochTask(
-                    f"pause:{name}", self, name, rec.epoch, rec.actives
-                ),
-            )
+            live = [a for a in rec.actives if a in self.ar_ids]
+            if live:
+                self.tasks.spawn_if_not_running(
+                    f"pause:{name}",
+                    lambda lv=live: PauseEpochTask(
+                        f"pause:{name}", self, name, rec.epoch, lv
+                    ),
+                )
         elif kind == REACTIVATE:
             assert rec is not None
             skey = f"start:{name}:{rec.epoch}"

@@ -132,12 +132,16 @@ class ReconfigurationRecord:
         self.row = -1
         return True
 
-    def start_reactivate(self, new_row: int) -> bool:
+    def start_reactivate(
+        self, new_row: int, actives: Optional[List[int]] = None
+    ) -> bool:
         """PAUSED/WAIT_PAUSE -> WAIT_ACK_START at a fresh row, same epoch
-        (also serves as the cancel path for a half-completed pause)."""
+        (also serves as the cancel path for a half-completed pause).
+        `actives` narrows the resume set when members left the cluster
+        while the group was paused."""
         if self.state not in (RCState.PAUSED, RCState.WAIT_PAUSE) or self.deleted:
             return False
-        self.new_actives = list(self.actives)
+        self.new_actives = list(actives) if actives else list(self.actives)
         self.new_row = int(new_row)
         self.resuming = True
         self.state = RCState.WAIT_ACK_START

@@ -31,6 +31,7 @@ class ReconfigurableCluster:
         make_app: Callable[[], Any],
         ar_log_dirs: Optional[List[str]] = None,
         rc_log_dirs: Optional[List[str]] = None,
+        demand_profile_cls=None,
     ):
         n_ar, n_rc = ar_cfg.n_replicas, rc_cfg.n_replicas
         self.ar_ids = list(range(n_ar))
@@ -52,12 +53,22 @@ class ReconfigurableCluster:
             self.active_replicas.append(
                 ActiveReplica(i, coord, self._sender(), rc_ids=self.rc_ids)
             )
+        # fault injection: RCs listed here are treated dead by the layer's
+        # primary takeover (and usually also cut off via msg_filter)
+        self.dead_rcs: set = set()
+        from ..reconfiguration.demand import AggregateDemandProfiler
+
         self.reconfigurators: List[Reconfigurator] = []
         for j in self.rc_ids:
             mgr = self.rcs.managers[j]
             self.reconfigurators.append(Reconfigurator(
                 j, mgr, mgr.app, self.ar_ids, self.rc_ids, self._sender(),
                 ar_n_groups=ar_cfg.n_groups,
+                is_node_up=lambda rc: rc not in self.dead_rcs,
+                demand_profiler=(
+                    AggregateDemandProfiler(demand_profile_cls)
+                    if demand_profile_cls else None
+                ),
             ))
         # bootstrap the RC-record RSM on every reconfigurator (the
         # AR_RC_NODES-style special group, created deterministically)

@@ -1,0 +1,214 @@
+"""RC primary failover (WaitPrimaryExecution analog) and demand-driven
+reconfiguration (handleDemandReport -> AbstractDemandProfile ->
+auto-migration)."""
+
+import time
+
+import pytest
+
+from gigapaxos_tpu.models.apps import HashChainApp
+from gigapaxos_tpu.ops.engine import EngineConfig
+from gigapaxos_tpu.reconfiguration import RCState
+from gigapaxos_tpu.reconfiguration.demand import (
+    AbstractDemandProfile,
+    AggregateDemandProfiler,
+    DemandProfile,
+)
+from gigapaxos_tpu.testing.rc_cluster import ReconfigurableCluster
+
+
+def make_cluster(**kw):
+    ar_cfg = EngineConfig(n_groups=16, window=8, req_lanes=4, n_replicas=4)
+    rc_cfg = EngineConfig(n_groups=8, window=8, req_lanes=4, n_replicas=3)
+    return ReconfigurableCluster(ar_cfg, rc_cfg, HashChainApp, **kw)
+
+
+def create(c, name, actives):
+    c.client_request("create_service", {"name": name, "actives": actives})
+    ack = c.wait_for("create_ack", max_steps=120)
+    assert ack and ack["ok"], ack
+
+
+def test_secondary_rc_completes_migration_after_primary_death(monkeypatch):
+    """Kill the record's primary RC mid-migration: a secondary adopts the
+    re-drive (primary_of skips dead RCs) and the migration completes
+    (match WaitPrimaryExecution.java:60)."""
+    from gigapaxos_tpu.reconfiguration import reconfigurator as rc_mod
+
+    c = make_cluster()
+    try:
+        # fast re-drives so the takeover happens within test steps
+        for rc in c.reconfigurators:
+            rc.REDRIVE_EVERY = 4
+        create(c, "ha", [0, 1, 2])
+        primary = c.reconfigurators[0].primary_of("ha")
+        # start a migration but IMMEDIATELY cut the primary off: its start
+        # round dies with it, stranding the record mid-transition
+        c.client_request("reconfigure", {"name": "ha", "new_actives": [1, 2, 3]},
+                         rc=primary)
+        for _ in range(3):
+            c.step()
+        c.dead_rcs.add(primary)
+        c.msg_filter = lambda dst, kind, body: dst != ("RC", primary)
+        # the layer object of the dead primary stops driving entirely
+        dead_layer = c.reconfigurators[primary]
+        monkeypatch.setattr(dead_layer, "tick", lambda now=None: None)
+        monkeypatch.setattr(
+            dead_layer, "handle_message", lambda *a, **k: None
+        )
+
+        deadline = time.time() + 30
+        rec = None
+        while time.time() < deadline:
+            c.step()
+            rec = c.reconfigurators[(primary + 1) % 3].rc_app.get_record("ha")
+            if rec is not None and rec.state is RCState.READY \
+                    and sorted(rec.actives) == [1, 2, 3]:
+                break
+        assert rec is not None and rec.state is RCState.READY, rec
+        assert sorted(rec.actives) == [1, 2, 3]
+        # the new epoch actually serves
+        done = {}
+        for _ in range(240):
+            if done:
+                break
+            c.ars.managers[1].propose(
+                "ha", "post-failover",
+                callback=lambda rid, r: done.setdefault(rid, r),
+            )
+            c.step()
+        assert done, "migrated group does not serve after RC failover"
+    finally:
+        c.close()
+
+
+class HotSpotProfile(AbstractDemandProfile):
+    """Test policy: once cumulative demand crosses a threshold, migrate to
+    the configured target set (stands in for locality policies like the
+    reference's GeoIpDemandProfile)."""
+
+    THRESHOLD = 12
+    TARGET = [1, 2, 3]
+
+    def __init__(self, name):
+        super().__init__(name)
+        self.total = 0
+
+    def combine(self, report):
+        self.total += int(report.get("count", 0))
+
+    def reconfigure(self, cur_actives, all_actives):
+        if self.total >= self.THRESHOLD:
+            return [a for a in self.TARGET if a in all_actives]
+        return None
+
+    def just_reconfigured(self):
+        self.total = 0
+
+
+def test_demand_report_drives_auto_migration():
+    """Sustained load on a name auto-migrates it via the demand pipeline:
+    AR counts -> demand_report -> primary's profile -> RECONFIGURE_INTENT
+    (match Reconfigurator.java:311, AbstractDemandProfile.java:103-149)."""
+    c = make_cluster(demand_profile_cls=HotSpotProfile)
+    try:
+        # fast demand flushes
+        for ar in c.active_replicas:
+            ar.demand_report_period_s = 0.05
+        create(c, "hot", [0, 1, 2])
+        done = {}
+        deadline = time.time() + 40
+        rec = None
+        i = 0
+        while time.time() < deadline:
+            i += 1
+            c.ars.managers[0].propose(
+                "hot", f"v{i}", callback=lambda rid, r: done.setdefault(rid, r)
+            )
+            c.step()
+            rec = c.reconfigurators[0].rc_app.get_record("hot")
+            if rec.state is RCState.READY and sorted(rec.actives) == [1, 2, 3]:
+                break
+        assert rec is not None and sorted(rec.actives) == [1, 2, 3], (
+            f"demand did not migrate: {rec and rec.to_json()}"
+        )
+        assert rec.epoch == 1
+    finally:
+        c.close()
+
+
+def test_default_profile_measures_but_never_migrates():
+    prof = DemandProfile("x")
+    prof.combine({"count": 1000, "from": 0})
+    assert prof.num_requests == 1000
+    assert prof.reconfigure([0, 1, 2], [0, 1, 2, 3]) is None
+    profiler = AggregateDemandProfiler(DemandProfile)
+    p = profiler.combine("x", {"count": 5, "from": 1})
+    assert p.num_requests == 5
+
+
+def test_elastic_membership_remove_and_add_active():
+    """Remove an active at runtime: the replicated AR set shrinks, rings
+    refresh on every RC, and the removed node's groups auto-migrate off it
+    (match Reconfigurator.java:1023-1075); re-adding restores the pool."""
+    c = make_cluster()
+    try:
+        for rc in c.reconfigurators:
+            rc.REDRIVE_EVERY = 4
+        create(c, "el", [0, 1, 2])
+        done = {}
+        for i in range(3):
+            c.ars.managers[0].propose(
+                "el", f"v{i}", callback=lambda rid, r: done.setdefault(rid, r)
+            )
+        for _ in range(60):
+            if len(done) == 3:
+                break
+            c.step()
+        assert len(done) == 3
+
+        c.client_request("remove_active", {"id": 0})
+        ack = c.wait_for("remove_active_ack", max_steps=120)
+        assert ack and ack["ok"], ack
+        assert 0 not in ack["actives"]
+
+        # the group migrates off node 0 via the re-drive scan
+        deadline = time.time() + 30
+        rec = None
+        while time.time() < deadline:
+            c.step()
+            rec = c.reconfigurators[0].rc_app.get_record("el")
+            if rec.state is RCState.READY and 0 not in rec.actives \
+                    and len(rec.actives) == 3:
+                break
+        assert rec is not None and 0 not in rec.actives, rec.to_json()
+        assert sorted(rec.actives) == [1, 2, 3]
+        # old host dropped the group; survivors serve with state intact
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if c.ars.managers[0].names.get("el") is None:
+                break
+            c.step()
+        assert c.ars.managers[0].names.get("el") is None
+        done2 = {}
+        for _ in range(240):
+            if done2:
+                break
+            c.ars.managers[1].propose(
+                "el", "after", callback=lambda rid, r: done2.setdefault(rid, r)
+            )
+            c.step()
+        assert done2, "group does not serve after membership removal"
+        a1 = c.ars.managers[1].app
+        assert a1.n_executed["el"] >= 4
+
+        # re-admit node 0
+        c.client_request("add_active", {"id": 0})
+        ack = c.wait_for("add_active_ack", max_steps=120)
+        assert ack and ack["ok"] and 0 in ack["actives"], ack
+        # explicit migration back onto it works
+        c.client_request("reconfigure", {"name": "el", "new_actives": [0, 1, 2]})
+        ack = c.wait_for("reconfigure_ack", max_steps=200)
+        assert ack and ack["ok"], ack
+    finally:
+        c.close()
