@@ -84,8 +84,13 @@ class MessageTransport:
         async def _shutdown():
             if self._server is not None:
                 self._server.close()
-            for task in self._senders.values():
-                task.cancel()
+            # cancel every task on this loop (senders AND the per-connection
+            # read handlers — leaving them pending spews "Task was
+            # destroyed" / "Event loop is closed" at interpreter exit)
+            me = asyncio.current_task()
+            for task in asyncio.all_tasks():
+                if task is not me:
+                    task.cancel()
             for w in self._writers.values():
                 try:
                     w.close()
@@ -125,8 +130,13 @@ class MessageTransport:
                     pass  # handler errors must not kill the read loop
         except (asyncio.IncompleteReadError, ConnectionError):
             pass
+        except asyncio.CancelledError:
+            raise
         finally:
-            writer.close()
+            try:
+                writer.close()
+            except Exception:
+                pass  # loop may already be closing (shutdown teardown)
 
     # ---- send path -----------------------------------------------------
     def send_to_id(self, node_id: int, payload: bytes) -> bool:

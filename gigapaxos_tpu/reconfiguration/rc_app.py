@@ -47,6 +47,10 @@ class RCRecordsApp(Replicable):
         # record analog, AbstractReconfiguratorDB.java:84-96); None means
         # "as configured at boot"
         self.ar_nodes: Optional[list] = None
+        # fired after restore() replaces the whole state (checkpoint
+        # transfer / recovery): the Reconfigurator refreshes its rings —
+        # ar_nodes can change without any op executing locally
+        self.on_restored: Optional[Callable[[], None]] = None
 
     # ---- Replicable ----------------------------------------------------
     def execute(self, request: Request, do_not_reply_to_client: bool = False) -> bool:
@@ -62,17 +66,28 @@ class RCRecordsApp(Replicable):
     def _apply(self, op: Dict) -> bool:
         kind = op["op"]
         if kind in (AR_ADD, AR_REMOVE):
+            # idempotent: a duplicate/raced proposal of an op that already
+            # took effect applies True (the client ack must not claim
+            # failure for a succeeded operation)
             nid = int(op["id"])
             cur = list(self.ar_nodes if self.ar_nodes is not None
                        else op.get("boot_actives") or [])
             if kind == AR_ADD:
-                if nid in cur:
-                    return False
-                cur.append(nid)
+                if nid not in cur:
+                    cur.append(nid)
             else:
-                if nid not in cur or len(cur) <= 1:
-                    return False  # never remove the last active
-                cur.remove(nid)
+                if nid in cur:
+                    if len(cur) <= 1:
+                        return False  # never remove the last active
+                    # a removal that would leave any record with NO live
+                    # member is refused: its data exists only in the
+                    # removed members' journals (silent loss otherwise)
+                    after = set(cur) - {nid}
+                    for rec in self.records.values():
+                        if not rec.deleted and rec.actives and \
+                                not (set(rec.actives) & after):
+                            return False
+                    cur.remove(nid)
             self.ar_nodes = sorted(cur)
             return True
         name = op["name"]
@@ -129,6 +144,7 @@ class RCRecordsApp(Replicable):
         # the whole record map is ONE RSM (one paxos group among the RCs),
         # so the checkpoint is the full map regardless of `name`
         return json.dumps({
+            "__fmt__": 2,  # versioned envelope: no service-name collisions
             "records": {n: r.to_json() for n, r in self.records.items()},
             "ar_nodes": self.ar_nodes,
         })
@@ -137,19 +153,17 @@ class RCRecordsApp(Replicable):
         if not state:
             self.records = {}
             self.ar_nodes = None
-            return True
-        d = json.loads(state)
-        # new format iff BOTH envelope keys exist and "records" isn't
-        # itself a record (a service literally named "records" in an old
-        # flat-map checkpoint would otherwise be misparsed)
-        if not ("records" in d and "ar_nodes" in d
-                and "name" not in (d["records"] or {})):
-            d = {"records": d, "ar_nodes": None}
-        self.records = {
-            n: ReconfigurationRecord.from_json(r)
-            for n, r in d["records"].items()
-        }
-        self.ar_nodes = d.get("ar_nodes")
+        else:
+            d = json.loads(state)
+            if d.get("__fmt__") != 2:  # pre-envelope flat record map
+                d = {"records": d, "ar_nodes": None}
+            self.records = {
+                n: ReconfigurationRecord.from_json(r)
+                for n, r in d["records"].items()
+            }
+            self.ar_nodes = d.get("ar_nodes")
+        if self.on_restored is not None:
+            self.on_restored()
         return True
 
     # ---- reads (RequestActiveReplicas analog) --------------------------

@@ -403,6 +403,7 @@ class Reconfigurator:
         self._last_attempt: Dict[str, int] = {}
         self._tick_count = 0
         rc_app.on_applied = self._on_applied
+        rc_app.on_restored = self._refresh_ar_ring
 
     # ------------------------------------------------------------------
     def primary_of(self, name: str) -> int:
@@ -643,16 +644,16 @@ class Reconfigurator:
     # Reconfigurator.java:1023-1075) -------------------------------------
     def _handle_membership(self, kind: str, body: Dict) -> None:
         nid = int(body["id"])
-        already = (nid in self.ar_ids) == (kind == "add_active")
-        if already:
-            # idempotent retransmit: the op already took effect (possibly
-            # via this client's earlier attempt) — a duplicate proposal
-            # would apply False and mislead the operator with ok=False
-            self._reply(body, f"{kind}_ack", str(nid), id=nid, ok=True,
-                        actives=sorted(self.ar_ids), already=True)
+        if not (0 <= nid < 32):
+            # engine membership is a 32-bit replica-id bitmask; a larger
+            # id would commit an unrepresentable member and wedge groups
+            self._reply(body, f"{kind}_ack", str(nid), id=nid, ok=False,
+                        reason="bad-id")
             return
         if body.get("client") is not None:
             self._pending_clients[f"#m:{kind}:{nid}"] = body["client"]
+        # always propose — the RSM applies idempotently, so the committed
+        # outcome (not this RC's possibly-stale local view) decides the ack
         self.propose_op({
             "op": AR_ADD if kind == "add_active" else AR_REMOVE,
             "id": nid,
@@ -728,12 +729,19 @@ class Reconfigurator:
                 rec.state not in (RCState.PAUSED, RCState.WAIT_PAUSE):
             return
         live = [a for a in rec.actives if a in self.ar_ids]
+        if not live:
+            # every member that holds this group's journal left the
+            # cluster: resuming on fresh nodes would silently reset the
+            # RSM to empty.  Stay paused — re-admitting any old member
+            # makes the next touch succeed (the AR_REMOVE guard makes
+            # this state unreachable except via direct record surgery).
+            return
         self.propose_op({
             "op": REACTIVATE, "name": name,
             "new_row": row_for(name, rec.epoch, 0, self.n_groups),
             # resume only on members still in the cluster (the READY
             # re-home scan grows the set back afterwards if short)
-            "actives": live or None,
+            "actives": live,
         })
 
     def _bad_actives(self, actives) -> bool:

@@ -187,8 +187,8 @@ class PaxosServer:
                 rows.append(my_blob)
         gathered = jax.tree.map(lambda *xs: jnp.stack(xs), *rows)
         want = self.fd.want_coord(
-            np.asarray(self.manager.state.bal),
-            np.asarray(self.manager.state.member_mask),
+            self.manager._np("bal"),
+            self.manager._np("member_mask"),
             R,
         )
         blob, delta = self.manager.tick(gathered, heard, want)

@@ -1,0 +1,161 @@
+"""Capacity prober: the reference's benchmark protocol against the full
+SYSTEM (sockets + JSON + tick loop + engine + app), not just the engine.
+
+Protocol (``TESTPaxosClient.probeCapacity``, ``TESTPaxosClient.java:
+799-895`` with knobs from ``TESTPaxosConfig.java:190-229``): inject load
+at rate R for a window; if the response rate stays >= PROBE_RESPONSE_
+THRESHOLD (0.9) and mean latency <= PROBE_LATENCY_THRESHOLD (1s), raise
+R by PROBE_LOAD_INCREASE_FACTOR (1.1) and repeat; the last sustainable R
+is the capacity ("capacity >= X/s").
+
+Boots an in-process loopback cluster of ReconfigurableNodes (3 actives +
+3 reconfigurators — the N-nodes-in-one-process testing mode) and drives
+it with the reconfiguration-aware client.  Emits one JSON line per round
+and a final summary line.
+"""
+
+import argparse
+import json
+import socket
+import sys
+import threading
+import time
+
+
+def free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--init-load", type=float, default=500.0,
+                    help="starting request rate/s (PROBE_INIT_LOAD analog)")
+    ap.add_argument("--factor", type=float, default=1.1)
+    ap.add_argument("--threshold", type=float, default=0.9)
+    ap.add_argument("--latency-ms", type=float, default=1000.0)
+    ap.add_argument("--window-s", type=float, default=3.0,
+                    help="measurement window per load step")
+    ap.add_argument("--groups", type=int, default=10)
+    ap.add_argument("--max-rounds", type=int, default=12)
+    ap.add_argument("--cpu", action="store_true",
+                    help="pin the JAX backend to CPU")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    from gigapaxos_tpu.clients.reconfigurable_client import (
+        ReconfigurableAppClient,
+    )
+    from gigapaxos_tpu.models.apps import NoopPaxosApp
+    from gigapaxos_tpu.ops.engine import EngineConfig
+    from gigapaxos_tpu.reconfigurable_node import ReconfigurableNode
+    from gigapaxos_tpu.utils.config import Config
+
+    ports = free_ports(6)
+    Config.clear()
+    for i in range(3):
+        Config.set(f"active.AR{i}", f"127.0.0.1:{ports[i]}")
+        Config.set(f"reconfigurator.RC{i}", f"127.0.0.1:{ports[3 + i]}")
+    ar_cfg = EngineConfig(
+        n_groups=max(64, args.groups * 2), window=16, req_lanes=8,
+        n_replicas=3,
+    )
+    rc_cfg = EngineConfig(n_groups=16, window=8, req_lanes=4, n_replicas=3)
+    nodes = [
+        ReconfigurableNode(f"{role}{i}", NoopPaxosApp,
+                           ar_cfg=ar_cfg, rc_cfg=rc_cfg)
+        for role in ("AR", "RC") for i in range(3)
+    ]
+    for n in nodes:
+        n.start()
+    client = ReconfigurableAppClient.from_properties()
+    names = [f"probe{i}" for i in range(args.groups)]
+    for nm in names:
+        ack = client.create_name(nm, actives=[0, 1, 2], timeout=60)
+        assert ack and ack.get("ok"), (nm, ack)
+    # warm the path (first requests compile/settle everything)
+    for nm in names:
+        client.send_request_sync(nm, "warm", timeout=30)
+
+    def run_round(rate: float):
+        """Fire at `rate` for window_s; return (resp_rate, mean_lat_s)."""
+        sent = 0
+        lock = threading.Lock()
+        done = []  # latencies
+
+        def cb_factory(t0):
+            def cb(rid, resp, error):
+                if not error:
+                    with lock:
+                        done.append(time.time() - t0)
+            return cb
+
+        interval = 1.0 / rate
+        t_end = time.time() + args.window_s
+        next_t = time.time()
+        i = 0
+        while time.time() < t_end:
+            now = time.time()
+            if now < next_t:
+                time.sleep(min(interval, next_t - now))
+                continue
+            next_t += interval
+            nm = names[i % len(names)]
+            i += 1
+            client.send_request(nm, f"p{i}", cb_factory(time.time()))
+            sent += 1
+        # grace: late responses within the latency budget still count
+        time.sleep(min(1.0, args.latency_ms / 1000.0))
+        with lock:
+            n_ok = len(done)
+            lat = sum(done) / n_ok if n_ok else float("inf")
+        return (n_ok / sent if sent else 0.0), lat
+
+    capacity = 0.0
+    rate = args.init_load
+    curve = []
+    try:
+        for rnd in range(args.max_rounds):
+            resp_rate, lat = run_round(rate)
+            ok = resp_rate >= args.threshold and lat * 1000 <= args.latency_ms
+            line = {
+                "round": rnd, "load_rps": round(rate, 1),
+                "response_rate": round(resp_rate, 3),
+                "mean_latency_ms": round(lat * 1000, 1),
+                "sustained": ok,
+            }
+            print(json.dumps(line), flush=True)
+            curve.append(line)
+            if not ok:
+                break
+            capacity = rate
+            rate *= args.factor
+        print(json.dumps({
+            "metric": "system_capacity_requests_per_s",
+            "value": round(capacity, 1),
+            "unit": f"req/s ({args.groups} groups, 3 actives + 3 RCs, "
+                    "loopback sockets, full system path)",
+            "protocol": f"x{args.factor} until resp<{args.threshold} "
+                        f"or latency>{args.latency_ms}ms",
+        }), flush=True)
+    finally:
+        client.close()
+        for n in nodes:
+            n.stop()
+        Config.clear()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
