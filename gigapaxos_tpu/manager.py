@@ -194,6 +194,10 @@ class PaxosManager:
         self.forward_out: List[Tuple[int, str, Dict]] = []  # (dst, kind, body)
         self._fired_callbacks: List[Tuple[Callable, int, Optional[str]]] = []
         self.app_exec_slot = np.zeros(G, np.int64)  # host app cursor per group
+        # rows whose app cursor moved since the last gossip: the cursor
+        # delta ships SPARSE (a full [G] list per tick is O(G) host work
+        # and wire bytes for idle groups)
+        self._app_exec_dirty: set = set()
         self.pending_exec: Dict[int, Dict[int, int]] = {}  # g -> slot -> vid
         # executed payloads retained for straggler pulls until every live
         # member's frontier passes the slot (sync/catch-up analog; a peer
@@ -416,6 +420,9 @@ class PaxosManager:
         # are not local stay pending and heal via runtime peer pulls
         self._drain_pending_exec()
         self._fired_callbacks.clear()  # no clients to answer at recovery
+        # first tick gossips a cursor baseline for everything live here
+        self._app_exec_dirty.update(self.names.values())
+        self._app_exec_dirty.update(self.old_epochs.values())
 
     # ------------------------------------------------------------------
     # lifecycle (createPaxosInstance / kill, PaxosManager.java:611,2142)
@@ -749,6 +756,7 @@ class PaxosManager:
                 **{k: jnp.asarray(v) for k, v in arrays.items()}
             )
             self.app_exec_slot[r] = int(rec.get("app_exec", rec["exec"]))
+            self._app_exec_dirty.add(r)
             # the _create_locked journal entry has the app state as init;
             # the consensus remnants need the pause record on replay too
             if self.logger:
@@ -916,11 +924,17 @@ class PaxosManager:
             ae = body.get("app_exec")
             if ae is not None:
                 rid, cursors = ae
-                cur = np.asarray(cursors, np.int64)
-                prev = self.peer_app_exec.get(rid)
-                self.peer_app_exec[rid] = (
-                    cur if prev is None else np.maximum(prev, cur)
-                )
+                arr = self.peer_app_exec.get(rid)
+                if arr is None:
+                    arr = np.zeros(self.cfg.n_groups, np.int64)
+                    self.peer_app_exec[rid] = arr
+                if isinstance(cursors, dict):  # sparse delta (normal path)
+                    for row_s, cur in cursors.items():
+                        row = int(row_s)
+                        if cur > arr[row]:
+                            arr[row] = cur
+                else:  # dense snapshot (legacy peers)
+                    np.maximum(arr, np.asarray(cursors, np.int64), out=arr)
         elif kind == "forward":  # a peer forwards a proposal to me
             self.propose(
                 body["name"], body["value"],
@@ -1024,13 +1038,21 @@ class PaxosManager:
             jnp.zeros((G,), bool) if want_coord is None
             else jnp.asarray(want_coord, bool)
         )
-        t0 = time.perf_counter()
+        t0 = time.monotonic()
         new_state, out = _step_jit(
             self.state, gathered, jnp.asarray(heard),
             jnp.asarray(req), wc, jnp.int32(self.my_id), cfg=cfg,
         )
         self.state = new_state
-        DelayProfiler.update_delay("engine_step", time.perf_counter() - t0)
+        # sync here so the engine's compute is attributed to the engine —
+        # jax dispatch is async and the implicit sync would otherwise land
+        # in the first np.asarray below, polluting host-cost accounting
+        # (the conversion right after forces the sync anyway, so this adds
+        # no real wall time to the tick)
+        jax.block_until_ready(out)
+        # update_delay takes the START time (it computes monotonic()-t0)
+        DelayProfiler.update_delay("engine_step", t0)
+        self.last_engine_step_s = time.monotonic() - t0
 
         out_np = jax.tree.map(np.asarray, out)
         self._tick_no += 1
@@ -1115,10 +1137,13 @@ class PaxosManager:
             }
         self._maybe_checkpoint(out_np)
 
+        dirty, self._app_exec_dirty = self._app_exec_dirty, set()
         host_delta = {
             "arena": payload_delta,
             "meta": {k: list(v) for k, v in meta_delta.items()},
-            "app_exec": (self.my_id, self.app_exec_slot.tolist()),
+            "app_exec": (self.my_id, {
+                int(g): int(self.app_exec_slot[g]) for g in dirty
+            }),
         }
         return make_blob(self.state), host_delta
 
@@ -1174,7 +1199,9 @@ class PaxosManager:
                     break  # payload not here yet; pull + retry next tick
                 del pend[cursor]
                 cursor += 1
-            self.app_exec_slot[g] = cursor
+            if cursor != int(self.app_exec_slot[g]):
+                self.app_exec_slot[g] = cursor
+                self._app_exec_dirty.add(g)
             if not pend:
                 del self.pending_exec[g]
         return missing
@@ -1379,6 +1406,7 @@ class PaxosManager:
             g = int(ent["row"])
             self.app.restore(ent["name"], ent["app_state"])
             self.app_exec_slot[g] = int(ent["exec"])
+            self._app_exec_dirty.add(g)
             self.pending_exec.pop(g, None)
             if int(ent["stopped"]) and self.on_stop_executed is not None:
                 # the STOP decision will never execute locally (the jump
@@ -1394,6 +1422,7 @@ class PaxosManager:
             g = int(ent["row"])
             self.app.restore(ent["name"], ent["app_state"])
             self.app_exec_slot[g] = int(ent["exec"])
+            self._app_exec_dirty.add(g)
             pend = self.pending_exec.get(g)
             if pend:  # decisions at/past the adopted cursor still execute
                 for slot in [s for s in pend if s < int(ent["exec"])]:

@@ -103,6 +103,27 @@ class ActiveReplicaServer(PaxosServer):
                 self._stop_events.append((name, row, epoch))
 
         self.manager.on_stop_executed = deferred_stop
+        # app-request REST (HttpActiveReplica analog) at port + offset
+        self._http = None
+        try:
+            from .http_front import start_ar_http
+
+            self._http = start_ar_http(
+                self.transport.listen_host,
+                self.transport.listen_port
+                + Config.get_int(PC.HTTP_PORT_OFFSET),
+                lambda name, value, cb: self.manager.propose(
+                    name, value, callback=cb
+                ),
+            )
+        except OSError:
+            pass  # HTTP port taken: binary protocol still fully serves
+
+    def stop(self) -> None:
+        if self._http is not None:
+            self._http.shutdown()
+            self._http.server_close()  # release the bound listen socket
+        super().stop()
 
     def _reply_client(self, dst, kind, body) -> None:
         pass  # ARs never address clients through the epoch plane
@@ -160,18 +181,64 @@ class ReconfiguratorServer(PaxosServer):
 
         self.rc_app.on_applied = deferred_applied
         self._layer_on_applied = layer_on_applied
+        # same deferral for restore (checkpoint transfer installs the app
+        # state on a transport thread under the manager lock; the ring
+        # refresh must run under the layer lock at tick time)
+        layer_on_restored = self.rc_app.on_restored
+        self._restored_pending = False
+
+        def deferred_restored() -> None:
+            with self._evt_lock:
+                self._restored_pending = True
+
+        self.rc_app.on_restored = deferred_restored
+        self._layer_on_restored = layer_on_restored
         # bootstrap the RC-record RSM (the AR_RC_NODES-style special group,
         # ReconfigurableNode.java:160-181): deterministic row on every RC
         self.manager.create_paxos_instance(RC_GROUP, rc_ids)
+        # REST front-end (HttpReconfigurator analog) at port + offset
+        self._http = None
+        try:
+            from .http_front import start_rc_http
+
+            def submit(kind: str, body: Dict, waiter) -> None:
+                op = dict(body)
+                op["client"] = self._register_client_fn(waiter)
+                with self._layer_lock:
+                    self.reconfigurator.handle_message(kind, op)
+
+            self._http = start_rc_http(
+                self.transport.listen_host,
+                self.transport.listen_port
+                + Config.get_int(PC.HTTP_PORT_OFFSET),
+                submit,
+            )
+        except OSError:
+            pass  # HTTP port taken: binary protocol still fully serves
+
+    def stop(self) -> None:
+        if self._http is not None:
+            self._http.shutdown()
+            self._http.server_close()  # release the bound listen socket
+        super().stop()
 
     # ---- client replies -------------------------------------------------
     def _register_client(self, reply) -> List:
+        """Socket client: replies re-encode as rc_client_reply frames."""
+        return self._register_client_fn(
+            lambda kind, body: reply(encode_json(
+                "rc_client_reply", self.my_id, {"kind": kind, "body": body}
+            ))
+        )
+
+    def _register_client_fn(self, fn: Callable[[str, Dict], None]) -> List:
+        """Register a decoded-reply sink (HTTP workers use this directly)."""
         with self._layer_lock:
             self._client_seq += 1
             token = str(self._client_seq)
             self._client_replies[token] = (
                 time.time() + Config.get_float(PC.REQUEST_TIMEOUT_S) * 8,
-                reply,
+                fn,
             )
             # opportunistic GC
             if self._client_seq % 64 == 0:
@@ -196,9 +263,7 @@ class ReconfiguratorServer(PaxosServer):
         with self._layer_lock:
             ent = self._client_replies.pop(token, None)
         if ent is not None:
-            ent[1](encode_json(
-                "rc_client_reply", self.my_id, {"kind": kind, "body": body}
-            ))
+            ent[1](kind, body)
 
     # ---- demux ----------------------------------------------------------
     def _on_json(self, k, sender, body, reply) -> bool:
@@ -225,7 +290,10 @@ class ReconfiguratorServer(PaxosServer):
     def _layer_tick(self) -> None:
         with self._evt_lock:
             events, self._applied_events = self._applied_events, []
+            restored, self._restored_pending = self._restored_pending, False
         with self._layer_lock:
+            if restored and self._layer_on_restored is not None:
+                self._layer_on_restored()
             for op in events:
                 self._layer_on_applied(op)
             self.reconfigurator.tick()

@@ -155,7 +155,14 @@ class RCRecordsApp(Replicable):
             self.ar_nodes = None
         else:
             d = json.loads(state)
-            if d.get("__fmt__") != 2:  # pre-envelope flat record map
+            # accept: versioned envelope, the brief unversioned envelope
+            # (both keys present and "records" not itself a record), and
+            # the original flat record map
+            enveloped = d.get("__fmt__") == 2 or (
+                "records" in d and "ar_nodes" in d
+                and "name" not in (d["records"] or {})
+            )
+            if not enveloped:
                 d = {"records": d, "ar_nodes": None}
             self.records = {
                 n: ReconfigurationRecord.from_json(r)

@@ -651,7 +651,11 @@ class Reconfigurator:
                         reason="bad-id")
             return
         if body.get("client") is not None:
-            self._pending_clients[f"#m:{kind}:{nid}"] = body["client"]
+            # a LIST: concurrent requesters of the same op must all be
+            # acked by the single committed apply, not just the last
+            self._pending_clients.setdefault(
+                f"#m:{kind}:{nid}", []
+            ).append(body["client"])
         # always propose — the RSM applies idempotently, so the committed
         # outcome (not this RC's possibly-stale local view) decides the ack
         self.propose_op({
@@ -676,7 +680,9 @@ class Reconfigurator:
         ):
             if len(keep) >= want:
                 break
-            if cand not in keep:
+            # belt: the ring rebuild and ar_ids update are two steps — a
+            # torn read must never re-admit a removed node
+            if cand not in keep and cand in self.ar_ids:
                 keep.append(cand)
         return keep
 
@@ -891,10 +897,10 @@ class Reconfigurator:
             if op.get("applied"):
                 self._refresh_ar_ring()
             kind = "add_active" if op["op"] == AR_ADD else "remove_active"
-            client = self._pending_clients.pop(
+            clients = self._pending_clients.pop(
                 f"#m:{kind}:{int(op['id'])}", None
             )
-            if client is not None:
+            for client in clients or []:
                 self.send(tuple(client), f"{kind}_ack", {
                     "id": int(op["id"]), "name": str(op["id"]),
                     "ok": bool(op.get("applied")),

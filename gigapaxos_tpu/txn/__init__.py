@@ -1,0 +1,3 @@
+from .transactor import DistTransactor, Transaction, TxnApp
+
+__all__ = ["DistTransactor", "Transaction", "TxnApp"]

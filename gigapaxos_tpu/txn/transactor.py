@@ -1,0 +1,200 @@
+"""Distributed transactions across RSM groups (experimental, matching the
+reference's scope).
+
+API-parity target: ``txn/DistTransactor.java`` (333 LoC wrapping an
+``AbstractReplicaCoordinator``) with the 2PC-style ops of
+``txn/txpackets/`` (LockRequest / UnlockRequest / TxOpRequest /
+CommitRequest / AbortRequest) — present and functional but explicitly
+*experimental*, exactly as in the reference (``SURVEY.md`` §2.6: "treat
+as capability stub: present, compiles, not load-bearing").
+
+Design: locks are themselves CONSENSUS operations.  :class:`TxnApp`
+wraps the user's Replicable; reserved ``__tx__``-prefixed request values
+are interpreted as lock-table ops (acquire/release/apply), everything
+else passes through — but is refused while the group is locked by a
+transaction, making each group's lock linearizable with its log.  The
+transactor acquires locks in sorted-name order (deadlock freedom),
+applies the ops, then releases — each step an ordinary replicated
+request, so crash recovery replays to a consistent lock state and an
+abort path releases whatever was acquired.
+
+Guarantee honesty (same envelope as the reference's experimental txn):
+this provides ISOLATION (no other request or transaction interleaves
+with a locked group) and lock-phase all-or-nothing, but an abort during
+the APPLY phase does not roll back ops already applied to earlier
+groups — there is no undo log.  An aborted result reports how many ops
+had applied (``applied_ops``) so callers can compensate.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..interfaces.app import Replicable, Request
+
+TX_PREFIX = "__tx__:"
+
+
+class TxnApp(Replicable):
+    """Replicable wrapper adding a per-name transaction lock table
+    (``TXLockerMap`` analog); the lock state is part of the RSM (it rides
+    checkpoints), so all replicas agree on it."""
+
+    def __init__(self, app: Replicable):
+        self.app = app
+        self.locks: Dict[str, str] = {}  # name -> holding txid
+
+    # ---- Replicable ----------------------------------------------------
+    def execute(self, request: Request, do_not_reply_to_client: bool = False) -> bool:
+        name = request.paxos_id
+        value = request.request_value or ""
+        if value.startswith(TX_PREFIX):
+            op = json.loads(value[len(TX_PREFIX):])
+            request.response_value = json.dumps(self._tx_op(name, op))
+            return True
+        holder = self.locks.get(name)
+        if holder is not None:
+            # group locked by an in-flight transaction: refuse (the client
+            # retries; LockRequest semantics)
+            request.response_value = json.dumps(
+                {"ok": False, "locked_by": holder}
+            )
+            return True
+        return self.app.execute(request, do_not_reply_to_client)
+
+    def _tx_op(self, name: str, op: Dict) -> Dict:
+        kind, txid = op["kind"], op["txid"]
+        holder = self.locks.get(name)
+        if kind == "lock":
+            if holder is None:
+                self.locks[name] = txid
+                return {"ok": True}
+            return {"ok": holder == txid, "locked_by": holder}
+        if kind == "unlock":
+            if holder == txid:
+                del self.locks[name]
+            return {"ok": True}  # idempotent
+        if kind == "apply":
+            if holder != txid:
+                return {"ok": False, "locked_by": holder}
+            from ..packets.paxos_packets import RequestPacket
+
+            inner = RequestPacket(
+                paxos_id=name, request_id=int(op["rid"]),
+                request_value=op["value"],
+            )
+            self.app.execute(inner, True)
+            return {"ok": True,
+                    "response": getattr(inner, "response_value", None)}
+        return {"ok": False, "error": f"unknown tx op {kind!r}"}
+
+    def checkpoint(self, name: str) -> Optional[str]:
+        return json.dumps({
+            "app": self.app.checkpoint(name),
+            "lock": self.locks.get(name),
+        })
+
+    def restore(self, name: str, state: Optional[str]) -> bool:
+        if state:
+            try:
+                d = json.loads(state)
+            except (json.JSONDecodeError, TypeError):
+                d = {"app": state, "lock": None}
+            if isinstance(d, dict) and "app" in d:
+                if d.get("lock") is not None:
+                    self.locks[name] = d["lock"]
+                else:
+                    self.locks.pop(name, None)
+                return self.app.restore(name, d["app"])
+        else:
+            self.locks.pop(name, None)
+        return self.app.restore(name, state)
+
+    def get_request(self, stringified: str):
+        return self.app.get_request(stringified)
+
+    # convenience passthroughs for fixtures
+    def __getattr__(self, item):
+        return getattr(self.app, item)
+
+
+class Transaction:
+    """An ordered set of (name, request_value) ops applied atomically
+    w.r.t. other transactions and single-group requests."""
+
+    def __init__(self, ops: List[Tuple[str, str]]):
+        self.ops = list(ops)
+        self.txid = f"tx{random.randrange(1 << 48):012x}"
+
+    @property
+    def names(self) -> List[str]:
+        return sorted({n for n, _ in self.ops})
+
+
+class DistTransactor:
+    """Drives transactions through any request submitter
+    (``DistTransactor.java`` analog).  ``submit(name, value, timeout)``
+    must deliver a consensus-executed response string or None."""
+
+    def __init__(self, submit, lock_timeout_s: float = 10.0):
+        self.submit = submit
+        self.lock_timeout_s = lock_timeout_s
+
+    def _tx(self, name: str, op: Dict, timeout: float) -> Optional[Dict]:
+        resp = self.submit(
+            name, TX_PREFIX + json.dumps(op, separators=(",", ":")), timeout
+        )
+        if resp is None:
+            return None
+        return json.loads(resp)
+
+    def execute(self, txn: Transaction, timeout: float = 30.0) -> Dict:
+        """Lock all groups (sorted order — deadlock-free), apply all ops,
+        unlock.  On failure: release acquired locks and report abort with
+        `applied_ops` (ops already applied are NOT rolled back — see the
+        module docstring's guarantee note)."""
+        deadline = time.time() + timeout
+        acquired: List[str] = []
+        applied = 0
+        try:
+            for name in txn.names:  # phase 1: lock
+                while True:
+                    r = self._tx(name, {"kind": "lock", "txid": txn.txid},
+                                 self.lock_timeout_s)
+                    if r and r.get("ok"):
+                        acquired.append(name)
+                        break
+                    if time.time() > deadline:
+                        return self._abort(txn, acquired, "lock-timeout", 0)
+                    time.sleep(0.05)  # holder backoff (TXLockerMap wait)
+            results = []
+            for i, (name, value) in enumerate(txn.ops):  # phase 2: apply
+                r = self._tx(name, {
+                    "kind": "apply", "txid": txn.txid,
+                    "rid": random.randrange(1 << 53, 1 << 62),
+                    "value": value,
+                }, max(1.0, deadline - time.time()))
+                if not (r and r.get("ok")):
+                    return self._abort(
+                        txn, acquired, f"apply-failed@{i}", applied
+                    )
+                applied += 1
+                results.append(r.get("response"))
+            self._release(txn, acquired)
+            return {"committed": True, "responses": results}
+        except Exception as e:  # release on any client-side failure
+            self._abort(txn, acquired, repr(e), applied)
+            raise
+
+    def _release(self, txn: Transaction, names: List[str]) -> None:
+        for name in names:
+            self._tx(name, {"kind": "unlock", "txid": txn.txid},
+                     self.lock_timeout_s)
+
+    def _abort(self, txn: Transaction, acquired: List[str], why: str,
+               applied: int) -> Dict:
+        self._release(txn, acquired)
+        return {"committed": False, "aborted": why, "applied_ops": applied}

@@ -16,10 +16,8 @@ from gigapaxos_tpu.testing.cluster import ManagerCluster
 
 
 def tick_host_cost(G, n_ticks=12, warmup=3):
-    """Mean host-side tick cost (total tick minus the jitted engine step)
-    for a single idle manager with a handful of live groups."""
-    from gigapaxos_tpu.utils.profiler import DelayProfiler
-
+    """Median host-side tick cost (total tick minus the jitted engine
+    steps, measured per tick) for idle managers with a few live groups."""
     cfg = EngineConfig(n_groups=G, window=8, req_lanes=4, n_replicas=3)
     c = ManagerCluster(cfg, NoopPaxosApp)
     for i in range(8):
@@ -28,26 +26,27 @@ def tick_host_cost(G, n_ticks=12, warmup=3):
     host_costs = []
     for _ in range(n_ticks):
         t0 = time.perf_counter()
-        before = DelayProfiler.get("engine_step")
         c.step_all()
-        after = DelayProfiler.get("engine_step")
         total = time.perf_counter() - t0
-        # 3 managers step per step_all; subtract their engine time
-        host_costs.append(total - 3 * (after if after else 0))
+        engine = sum(m.last_engine_step_s for m in c.managers)
+        host_costs.append(total - engine)
     c.close()
     host_costs.sort()
     return host_costs[len(host_costs) // 2]  # median
 
 
-def test_idle_group_host_cost_near_flat():
-    """8x more idle rows must not inflate the host-side tick cost by more
-    than ~3x (numpy O(G) masks are fine — per-group Python loops or
-    per-call device syncs are not: those blow up 8x+)."""
-    small = tick_host_cost(16_384)
-    big = tick_host_cost(131_072)
-    assert big < max(3.5 * small, small + 0.08), (
-        f"host tick cost scales with G: {small * 1000:.1f}ms @16k -> "
-        f"{big * 1000:.1f}ms @131k"
+def test_idle_group_host_cost_is_array_speed():
+    """Idle groups must cost ARRAY speed on the host, not Python speed.
+
+    The tick's host side legitimately moves O(G*W) bytes (the blob
+    exchange IS the state transfer in host-exchange mode), so the bound
+    is per-group cost: numpy-batch work runs ~1-2us/group for the whole
+    3-replica round; per-group Python loops or per-call device syncs run
+    5-10us+/group and blow the budget immediately."""
+    per_group = tick_host_cost(131_072) / 131_072
+    assert per_group < 4e-6, (
+        f"host tick cost {per_group * 1e6:.2f}us/group at G=131k — "
+        "something walks idle groups in Python"
     )
 
 

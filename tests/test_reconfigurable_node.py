@@ -140,3 +140,47 @@ def test_create_request_migrate_delete_over_sockets(cluster):
         time.sleep(0.1)
     for i in (3, 4, 5):
         assert nodes[i].servers[0].rc_app.get_record("svc") is None
+
+
+def test_http_front_ends(cluster):
+    """REST parity: create/resolve via the reconfigurator's HTTP API and
+    execute an app request via an active's HTTP API (HttpReconfigurator
+    .java:79 / HttpActiveReplica.java:29 analogs)."""
+    import json as _json
+    import urllib.request
+
+    from gigapaxos_tpu.paxos_config import PC
+    from gigapaxos_tpu.utils.config import Config
+
+    nodes, client = cluster
+    off = Config.get_int(PC.HTTP_PORT_OFFSET)
+    rc = nodes[3].servers[0]
+    ar = nodes[0].servers[0]
+    assert rc._http is not None and ar._http is not None
+    rc_url = f"http://127.0.0.1:{rc.transport.listen_port + off}"
+    ar_url = f"http://127.0.0.1:{ar.transport.listen_port + off}"
+
+    def post(url, payload, timeout=30):
+        req = urllib.request.Request(
+            url, data=_json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, _json.loads(r.read())
+
+    code, body = post(rc_url, {
+        "type": "CREATE", "name": "httpsvc", "actives": [0, 1, 2],
+    })
+    assert code == 200 and body["ok"], body
+
+    with urllib.request.urlopen(
+        f"{rc_url}/?name=httpsvc", timeout=20
+    ) as r:
+        resolved = _json.loads(r.read())
+    assert resolved["ok"] and sorted(resolved["actives"]) == [0, 1, 2]
+
+    code, body = post(ar_url, {"name": "httpsvc", "request": "via-http"})
+    assert code == 200 and body["response"] is not None, body
+
+    code, body = post(rc_url, {"type": "DELETE", "name": "httpsvc"})
+    assert code == 200 and body["ok"], body
