@@ -22,6 +22,7 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..net.codec import decode_json, decode_kind, encode_json
+from ..net.rtt import LatencyAwareRedirector
 from ..reconfiguration.rc_config import RC
 from ..utils.config import Config
 from .base import Addr, AsyncFrameClient
@@ -39,6 +40,9 @@ class ReconfigurableAppClient(AsyncFrameClient):
         self.reconfigurators = list(reconfigurators)
         self.my_tag = my_tag
         self.cache_ttl = Config.get_float(RC.ACTIVES_CACHE_TTL_S)
+        # nearest-replica selection (E2ELatencyAwareRedirector analog):
+        # learned per-active latency EWMA with a probe ratio
+        self.redirector = LatencyAwareRedirector()
         # name -> (expiry, [active ids]) — the TTL'd request->actives table
         self._actives_cache: Dict[str, Tuple[float, List[int]]] = {}
         # app-request callbacks: request_id -> (time, cb(rid, resp, error))
@@ -198,14 +202,14 @@ class ReconfigurableAppClient(AsyncFrameClient):
             acts = [a for a in acts if int(a) in self.actives]
         if not acts:
             return None
-        target = active if active is not None else random.choice(acts)
+        target = active if active is not None else self.redirector.pick(acts)
         addr = self.actives.get(int(target))
         if addr is None:
             return None
         if request_id is None:
             request_id = self.mint_id()
         with self._lock:
-            self._callbacks[request_id] = (time.time(), callback)
+            self._callbacks[request_id] = (time.time(), callback, int(target))
         self.send_frame(addr, encode_json("client_request", self.my_tag, {
             "name": name, "value": value,
             "request_id": request_id, "stop": stop,
@@ -256,17 +260,26 @@ class ReconfigurableAppClient(AsyncFrameClient):
     def _dispatch(self, payload: bytes) -> None:
         if decode_kind(payload) != "J":
             return
-        k, _s, body = decode_json(payload)
+        k, sender, body = decode_json(payload)
         if k == "client_response":
             rid = int(body["request_id"])
+            now = time.time()
             with self._lock:
                 ent = self._callbacks.get(rid)
                 if not body.get("error"):
                     self._callbacks.pop(rid, None)
-                cut = time.time() - self.callback_ttl
-                for dead in [r for r, (t, _) in self._callbacks.items() if t < cut]:
+                cut = now - self.callback_ttl
+                for dead in [r for r in self._callbacks
+                             if self._callbacks[r][0] < cut]:
                     del self._callbacks[dead]
             if ent:
+                # only attribute the RTT when THIS server answered: under
+                # retransmission the table holds the latest target/time,
+                # and a slow earlier server's late reply must not poison
+                # a different server's EWMA
+                if not body.get("error") and ent[2] is not None \
+                        and int(sender) == int(ent[2]):
+                    self.redirector.record(ent[2], now - ent[0])
                 ent[1](rid, body.get("response"), body.get("error"))
         elif k == "rc_client_reply":
             kind = body.get("kind")

@@ -42,7 +42,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from .interfaces.app import Replicable
-from .ops.ballot import NULL, ballot_coord
+from .ops.ballot import NULL, ballot_coord, ballot_num, encode_ballot
+from .packets.paxos_packets import StatePacket, SyncDecisionsPacket
 from .paxos_config import PC
 from .utils.config import Config
 from .ops.engine import (
@@ -163,8 +164,21 @@ class PaxosManager:
         self.on_stop_executed: Optional[Callable[[str, int, int], None]] = None
         # residency (pause/unpause, PaxosManager.java:2264-2392 analog):
         # paused groups' snapshots, keyed (name, epoch) — their rows are
-        # freed for reuse; reactivation restores at a freshly probed row
-        self.paused: Dict[Tuple[str, int], Dict] = {}
+        # freed for reuse; reactivation restores at a freshly probed row.
+        # With a journal, the table itself pages to disk (DiskMap analog,
+        # DiskMap.java:97): at 1M groups the paused snapshots must not all
+        # be RAM-resident (durability is the journal's job regardless)
+        if log_dir:
+            import os as _os
+
+            from .utils.diskmap import DiskMap
+
+            self.paused = DiskMap(
+                _os.path.join(log_dir, "paused_spill"),
+                capacity=Config.get_int(PC.PAUSE_BATCH_SIZE) * 4,
+            )
+        else:
+            self.paused = {}
         self.row_activity = np.zeros(G, np.float64)  # wall time of last use
         # per-name arriving-request counts since the last demand report
         # (updateDemandStats analog; drained by the ActiveReplica layer)
@@ -948,15 +962,16 @@ class PaxosManager:
             self._apply_state_reply(
                 body["states"], body.get("response_cache") or {}
             )
-        elif kind == "need_payloads":  # straggler pull (sync analog)
-            have = {v: self.arena[v] for v in body["vids"] if v in self.arena}
+        elif kind == "need_payloads":  # straggler pull (SYNC_DECISIONS)
+            sync = SyncDecisionsPacket.from_json(body)
+            have = {v: self.arena[v] for v in sync.missing if v in self.arena}
             if have:
                 meta = {
                     v: list(self.vid_meta[v])
                     for v in have if v in self.vid_meta
                 }
                 self.forward_out.append(
-                    (body["from"], "payloads", {"arena": have, "meta": meta})
+                    (sync.node_id, "payloads", {"arena": have, "meta": meta})
                 )
 
     # ------------------------------------------------------------------
@@ -1137,6 +1152,12 @@ class PaxosManager:
             }
         self._maybe_checkpoint(out_np)
 
+        # periodic full-baseline refresh: a dropped gossip frame must not
+        # strand peers' cursor views forever (the sparse delta has no
+        # pull/heal path of its own) — O(live groups), not O(G)
+        if self._tick_no % 256 == 0:
+            self._app_exec_dirty.update(self.names.values())
+            self._app_exec_dirty.update(self.old_epochs.values())
         dirty, self._app_exec_dirty = self._app_exec_dirty, set()
         host_delta = {
             "arena": payload_delta,
@@ -1174,7 +1195,10 @@ class PaxosManager:
         missing = self._drain_pending_exec()
         if missing:
             self.forward_out.append(
-                (-1, "need_payloads", {"vids": missing, "from": self.my_id})
+                (-1, "need_payloads", SyncDecisionsPacket(
+                    node_id=self.my_id, missing=missing,
+                    is_missing_too_much=len(missing) > self.cfg.window,
+                ).to_json())
             )
         # retention GC: drop payloads every live member has executed past
         if self._tick_no % 32 == 0 and self.retained:
@@ -1325,15 +1349,17 @@ class PaxosManager:
             frontier = int(exec_np[g])
             if int(self.app_exec_slot[g]) != frontier:
                 continue  # app cursor lags the device: snapshot inconsistent
-            states.append({
-                "row": g, "name": name, "version": int(ent["version"]),
-                "exec": frontier,
-                "bal": int(self._np("bal")[g]),
-                "app_hash": int(self._np("app_hash")[g]),
-                "n_execd": int(self._np("n_execd")[g]),
-                "stopped": int(self._np("stopped")[g]),
-                "app_state": self.app.checkpoint(name),
-            })
+            bal = int(self._np("bal")[g])
+            states.append(StatePacket(
+                paxos_id=name, version=int(ent["version"]),
+                ballot_num=int(ballot_num(bal)),
+                ballot_coord=int(ballot_coord(bal)),
+                slot=frontier, row=g,
+                app_hash=int(self._np("app_hash")[g]),
+                n_execd=int(self._np("n_execd")[g]),
+                stopped=int(self._np("stopped")[g]),
+                state=self.app.checkpoint(name),
+            ).to_json())
         if states:
             # Response-cache entries for the served rows ride along:
             # without them the receiver cannot dedup a duplicate decision
@@ -1357,13 +1383,26 @@ class PaxosManager:
     def _apply_state_reply(
         self, states: List[Dict], response_cache: Optional[Dict] = None
     ) -> None:
-        """Adopt donor frontiers for rows still stranded (jumpSlot)."""
+        """Adopt donor frontiers for rows still stranded (jumpSlot).
+        Entries are StatePacket JSON (the CHECKPOINT_STATE wire schema)."""
         from .ops.lifecycle import jump_rows
 
         W = self.cfg.window
         exec_np = self._np("exec_slot")
         jumps: List[Dict] = []      # engine jump + app restore
         app_only: List[Dict] = []   # app restore only (device was current)
+        states = [
+            {
+                "row": int(p_.row), "name": p_.paxos_id,
+                "version": int(p_.version), "exec": int(p_.slot),
+                "bal": int(encode_ballot(p_.ballot_num, p_.ballot_coord)),
+                "app_hash": int(p_.app_hash),
+                "n_execd": int(p_.n_execd),
+                "stopped": int(p_.stopped),
+                "app_state": p_.state,
+            }
+            for p_ in (StatePacket.from_json(e) for e in states)
+        ]
         for ent in states:
             g, name = int(ent["row"]), ent["name"]
             if self.names.get(name) != g:
@@ -1459,7 +1498,11 @@ class PaxosManager:
             "names": self.names,
             "pending_rows": sorted(self.pending_rows),
             "paused": {
-                f"{n}@{e}": rec for (n, e), rec in self.paused.items()
+                f"{n}@{e}": rec for (n, e), rec in (
+                    self.paused.peek_items()
+                    if hasattr(self.paused, "peek_items")
+                    else self.paused.items()
+                )
             },
             "old_epochs": [[n, e, r] for (n, e), r in self.old_epochs.items()],
             "next_counter": self._next_counter,

@@ -1,0 +1,58 @@
+"""RTT estimation + latency-aware server selection.
+
+API-parity targets: ``nioutils/RTTEstimator`` (EWMA RTT per address) and
+``paxosutil/E2ELatencyAwareRedirector.java:18`` (the client-side policy:
+send to the lowest-learned-latency server, with a small probe ratio of
+random picks so alternatives keep being measured)."""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Any, Dict, List, Optional
+
+
+class RTTEstimator:
+    """EWMA round-trip estimate per key (server id / address)."""
+
+    ALPHA = 1.0 / 8
+
+    def __init__(self):
+        self._rtt: Dict[Any, float] = {}
+        self._lock = threading.Lock()
+
+    def record(self, key: Any, rtt_s: float) -> None:
+        with self._lock:
+            old = self._rtt.get(key)
+            self._rtt[key] = (
+                rtt_s if old is None else (1 - self.ALPHA) * old
+                + self.ALPHA * rtt_s
+            )
+
+    def get(self, key: Any) -> Optional[float]:
+        with self._lock:
+            return self._rtt.get(key)
+
+
+class LatencyAwareRedirector:
+    """Pick the fastest-known candidate, probing randomly at PROBE_RATIO
+    so a currently-slow server can redeem itself (E2ELatencyAwareRedirector
+    semantics: learned EWMA + probe rate)."""
+
+    PROBE_RATIO = 0.1
+
+    def __init__(self, estimator: Optional[RTTEstimator] = None):
+        self.rtt = estimator or RTTEstimator()
+
+    def pick(self, candidates: List[Any]) -> Any:
+        if not candidates:
+            raise ValueError("no candidates")
+        if random.random() < self.PROBE_RATIO:
+            return random.choice(candidates)
+        unknown = [c for c in candidates if self.rtt.get(c) is None]
+        if unknown:
+            return random.choice(unknown)  # measure everyone once
+        return min(candidates, key=lambda c: self.rtt.get(c))
+
+    def record(self, key: Any, rtt_s: float) -> None:
+        self.rtt.record(key, rtt_s)

@@ -62,6 +62,10 @@ class MessageTransport:
         self.n_sent = 0
         self.n_rcvd = 0
         self.n_dropped = 0  # congestion drops (NIOInstrumenter analog)
+        # WAN emulation hook (JSONDelayEmulator analog, nio/
+        # JSONDelayEmulator.java:36-56): delay_fn(addr) -> seconds of
+        # artificial link delay before a frame is queued for delivery
+        self.delay_fn: Optional[Callable[[Tuple[str, int]], float]] = None
 
     # ---- lifecycle -----------------------------------------------------
     def start(self) -> None:
@@ -105,15 +109,24 @@ class MessageTransport:
         self._thread.join(timeout=5)
 
     # ---- receive path --------------------------------------------------
+    # reply-path write-buffer cap: a slow client must not buffer replies
+    # unboundedly in its connection's writer (congestion -> drop, like the
+    # forward path; clients retransmit)
+    REPLY_BUFFER_LIMIT = 8 * 1024 * 1024
+
     async def _on_connection(self, reader: asyncio.StreamReader, writer):
         peer = writer.get_extra_info("peername") or ("?", 0)
 
         def reply(payload: bytes) -> None:
             def _w():
                 try:
+                    if writer.transport.get_write_buffer_size() \
+                            > self.REPLY_BUFFER_LIMIT:
+                        self.n_dropped += 1
+                        return
                     writer.write(_HDR.pack(MAGIC, len(payload)) + payload)
                 except Exception:
-                    pass
+                    self.n_dropped += 1
             self._loop.call_soon_threadsafe(_w)
 
         try:
@@ -151,7 +164,13 @@ class MessageTransport:
         if self._stopped:
             return False
         addr = (addr[0], int(addr[1]))
-        self._loop.call_soon_threadsafe(self._enqueue, addr, payload)
+        delay = self.delay_fn(addr) if self.delay_fn is not None else 0.0
+        if delay > 0:
+            self._loop.call_soon_threadsafe(
+                self._loop.call_later, delay, self._enqueue, addr, payload
+            )
+        else:
+            self._loop.call_soon_threadsafe(self._enqueue, addr, payload)
         return True
 
     def _enqueue(self, addr: Tuple[str, int], payload: bytes) -> None:

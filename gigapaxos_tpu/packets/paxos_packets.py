@@ -273,13 +273,21 @@ class BatchedCommit(PaxosPacket):
 
 @dataclass
 class StatePacket(PaxosPacket):
-    """Checkpoint transfer (ref: ``StatePacket.java``)."""
+    """Checkpoint transfer (ref: ``StatePacket.java``) — the LIVE schema
+    of the manager's straggler state_request/state_reply pulls
+    (``PaxosManager._serve_state_request``): a donor's consistent
+    (frontier == app cursor) snapshot of one group."""
 
     PACKET_TYPE: ClassVar[PaxosPacketType] = PaxosPacketType.CHECKPOINT_STATE
     ballot_num: int = -1
     ballot_coord: int = -1
-    slot: int = -1
-    state: Optional[str] = None
+    slot: int = -1           # donor's executed frontier
+    state: Optional[str] = None  # app checkpoint string
+    # TPU-build extras: row alignment + device-side RSM probes
+    row: int = -1
+    app_hash: int = 0
+    n_execd: int = 0
+    stopped: int = 0
 
 
 @dataclass

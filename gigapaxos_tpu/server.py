@@ -215,10 +215,15 @@ class PaxosServer:
                 self.transport.send_to_id(dst, frame)
 
         # failure-detection pings at period = timeout/2
+        # (FailureDetectionPacket wire schema, FailureDetectionPacket.java)
         now = time.time()
         if now - self._last_ping > self.fd.ping_period_s:
             self._last_ping = now
-            ping = encode_json("fd_ping", self.my_id, {"t": now})
+            from .packets.paxos_packets import FailureDetectionPacket
+
+            ping = encode_json("fd_ping", self.my_id, FailureDetectionPacket(
+                sender=str(self.my_id), send_time=now,
+            ).to_json())
             for r in peers:
                 self.transport.send_to_id(r, ping)
 

@@ -1,0 +1,140 @@
+"""DiskMap — a capacity-bounded mapping that pages cold entries to disk.
+
+API-parity target: ``utils/DiskMap`` (``DiskMap.java:97``): a map that
+"pauses" idle entries to disk via commit/restore and transparently
+restores them on access — the reference uses it for the journal's
+per-group ``LogIndex`` and optionally the RC DB.  Here it bounds the RAM
+of host-side per-group tables (e.g. the residency pause records: at the
+1M-group design scale the paused-snapshot table must not live fully in
+memory).
+
+Not a durability mechanism: the journal/checkpoint own persistence; a
+DiskMap's spill directory is scratch owned by one process instance.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from collections import OrderedDict
+from collections.abc import MutableMapping
+from typing import Any, Callable, Iterator, Optional
+
+
+class DiskMap(MutableMapping):
+    def __init__(
+        self,
+        directory: str,
+        capacity: int = 65536,
+        serialize: Callable[[Any], str] = lambda v: json.dumps(v),
+        deserialize: Callable[[str], Any] = lambda s: json.loads(s),
+    ):
+        if capacity < 2:
+            raise ValueError("capacity must be >= 2")
+        self.dir = directory
+        self.capacity = int(capacity)
+        self._ser = serialize
+        self._de = deserialize
+        os.makedirs(directory, exist_ok=True)
+        self._mem: "OrderedDict[Any, Any]" = OrderedDict()  # LRU: MRU last
+        self._on_disk: dict = {}  # key -> filename
+        # clear stale spills from a previous incarnation (scratch semantics)
+        for f in os.listdir(directory):
+            if f.endswith(".dm"):
+                try:
+                    os.remove(os.path.join(directory, f))
+                except OSError:
+                    pass
+
+    # ---- spill machinery (commit/restore analog) -----------------------
+    def _fname(self, key: Any) -> str:
+        h = hashlib.blake2b(repr(key).encode(), digest_size=12).hexdigest()
+        return f"{h}.dm"
+
+    def _spill_lru(self) -> None:
+        """Page out the least-recently-used half (Deactivator batch).
+        Write-before-pop: a failed spill (ENOSPC) must not lose the entry
+        — it stays in memory and the error surfaces to the caller."""
+        n = max(1, len(self._mem) - self.capacity // 2)
+        for _ in range(n):
+            key = next(iter(self._mem))
+            value = self._mem[key]
+            fname = self._fname(key)
+            path = os.path.join(self.dir, fname)
+            with open(path, "w", encoding="utf-8") as f:
+                f.write(self._ser(value))
+            del self._mem[key]
+            self._on_disk[key] = fname
+
+    def _restore(self, key: Any) -> Any:
+        fname = self._on_disk.pop(key)
+        path = os.path.join(self.dir, fname)
+        with open(path, "r", encoding="utf-8") as f:
+            value = self._de(f.read())
+        os.remove(path)
+        self[key] = value  # promotes (and may re-spill others)
+        return value
+
+    # ---- MutableMapping ------------------------------------------------
+    def __getitem__(self, key: Any) -> Any:
+        if key in self._mem:
+            self._mem.move_to_end(key)
+            return self._mem[key]
+        if key in self._on_disk:
+            return self._restore(key)
+        raise KeyError(key)
+
+    def __setitem__(self, key: Any, value: Any) -> None:
+        if key in self._on_disk:
+            fname = self._on_disk.pop(key)
+            try:
+                os.remove(os.path.join(self.dir, fname))
+            except OSError:
+                pass
+        self._mem[key] = value
+        self._mem.move_to_end(key)
+        if len(self._mem) > self.capacity:
+            self._spill_lru()
+
+    def __delitem__(self, key: Any) -> None:
+        if key in self._mem:
+            del self._mem[key]
+            return
+        fname = self._on_disk.pop(key, None)
+        if fname is None:
+            raise KeyError(key)
+        try:
+            os.remove(os.path.join(self.dir, fname))
+        except OSError:
+            pass
+
+    def __contains__(self, key: Any) -> bool:
+        return key in self._mem or key in self._on_disk
+
+    def __iter__(self) -> Iterator:
+        yield from list(self._mem)
+        yield from list(self._on_disk)
+
+    def __len__(self) -> int:
+        return len(self._mem) + len(self._on_disk)
+
+    def peek_items(self) -> Iterator:
+        """(key, value) over everything WITHOUT promoting spilled entries
+        (plain items() restores each spilled key into memory — a full
+        iteration, e.g. for checkpointing, would defeat the RAM bound and
+        churn every spill file)."""
+        for key in list(self._mem):
+            yield key, self._mem[key]
+        for key, fname in list(self._on_disk.items()):
+            with open(os.path.join(self.dir, fname), "r",
+                      encoding="utf-8") as f:
+                yield key, self._de(f.read())
+
+    @property
+    def n_in_memory(self) -> int:
+        return len(self._mem)
+
+    @property
+    def n_on_disk(self) -> int:
+        return len(self._on_disk)

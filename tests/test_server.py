@@ -114,3 +114,30 @@ def test_coordinator_failover_over_sockets():
                 s.stop()
             except Exception:
                 pass
+
+
+def test_delay_emulator_adds_link_latency():
+    """JSONDelayEmulator analog: per-link artificial delay on the socket
+    transport (WAN emulation in one process)."""
+    import time as _time
+
+    servers, client, ports = boot_cluster()
+    try:
+        client.create_paxos_instance("lag", [0, 1, 2])
+        r0 = client.send_request_sync("lag", "fast", timeout=15)
+        assert r0 is not None
+        # 150ms on every inter-server link; client links unaffected
+        server_ports = {s.transport.listen_port for s in servers}
+        for s in servers:
+            s.transport.delay_fn = (
+                lambda addr, sp=server_ports: 0.15 if addr[1] in sp else 0.0
+            )
+        t0 = _time.time()
+        r1 = client.send_request_sync("lag", "slow", timeout=30)
+        dt = _time.time() - t0
+        assert r1 is not None
+        assert dt > 0.15, f"emulated link delay not observed ({dt * 1000:.0f}ms)"
+    finally:
+        for s in servers:
+            s.stop()
+        client.close()

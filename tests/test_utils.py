@@ -86,3 +86,39 @@ def test_flags_reach_the_framework(tmp_path):
         assert m2.checkpoint_every == 11
     finally:
         Config.clear()
+
+
+def test_diskmap_spills_and_restores(tmp_path):
+    """DiskMap analog (DiskMap.java:97): cold entries page to disk and
+    restore transparently; deletes reach spilled entries."""
+    from gigapaxos_tpu.utils.diskmap import DiskMap
+
+    dm = DiskMap(str(tmp_path / "dm"), capacity=8)
+    for i in range(20):
+        dm[("k", i)] = {"v": i}
+    assert len(dm) == 20
+    assert dm.n_in_memory <= 8 and dm.n_on_disk >= 12
+    # every entry readable (spilled ones restore)
+    for i in range(20):
+        assert dm[("k", i)] == {"v": i}
+    # delete reaches both tiers
+    del dm[("k", 3)]
+    assert ("k", 3) not in dm and len(dm) == 19
+    # overwrite of a spilled key doesn't leave a stale file
+    dm[("k", 5)] = {"v": 500}
+    assert dm[("k", 5)] == {"v": 500}
+    assert set(dm) == {("k", i) for i in range(20) if i != 3}
+
+
+def test_rtt_redirector_prefers_fast_server():
+    from gigapaxos_tpu.net.rtt import LatencyAwareRedirector
+
+    rd = LatencyAwareRedirector()
+    rd.PROBE_RATIO = 0.0  # deterministic for the test
+    for _ in range(20):
+        rd.record(0, 0.100)
+        rd.record(1, 0.005)
+        rd.record(2, 0.050)
+    assert rd.pick([0, 1, 2]) == 1
+    # unknown candidates get measured before exploitation settles
+    assert rd.pick([0, 1, 7]) == 7
