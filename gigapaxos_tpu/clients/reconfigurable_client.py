@@ -45,8 +45,9 @@ class ReconfigurableAppClient(AsyncFrameClient):
         self.redirector = LatencyAwareRedirector()
         # name -> (expiry, [active ids]) — the TTL'd request->actives table
         self._actives_cache: Dict[str, Tuple[float, List[int]]] = {}
-        # app-request callbacks: request_id -> (time, cb(rid, resp, error))
-        self._callbacks: Dict[int, Tuple[float, Callable]] = {}
+        # app-request callbacks:
+        # request_id -> (send_time, cb(rid, resp, error), target, n_sends)
+        self._callbacks: Dict[int, Tuple[float, Callable, Optional[int], int]] = {}
         # rc-op waiters: (ack_kind, name) -> (event, box)
         self._rc_waiters: Dict[Tuple[str, str], Tuple[threading.Event, Dict]] = {}
 
@@ -209,7 +210,11 @@ class ReconfigurableAppClient(AsyncFrameClient):
         if request_id is None:
             request_id = self.mint_id()
         with self._lock:
-            self._callbacks[request_id] = (time.time(), callback, int(target))
+            prev = self._callbacks.get(request_id)
+            self._callbacks[request_id] = (
+                time.time(), callback, int(target),
+                (prev[3] + 1) if prev else 1,
+            )
         self.send_frame(addr, encode_json("client_request", self.my_tag, {
             "name": name, "value": value,
             "request_id": request_id, "stop": stop,
@@ -273,12 +278,13 @@ class ReconfigurableAppClient(AsyncFrameClient):
                              if self._callbacks[r][0] < cut]:
                     del self._callbacks[dead]
             if ent:
-                # only attribute the RTT when THIS server answered: under
-                # retransmission the table holds the latest target/time,
-                # and a slow earlier server's late reply must not poison
-                # a different server's EWMA
+                # RTT attribution only when it is unambiguous: the reply
+                # came from the recorded target AND the request was sent
+                # exactly once — under retransmission the send time is the
+                # LATEST attempt's, so a slow server's late reply to the
+                # first attempt would record a falsely tiny RTT
                 if not body.get("error") and ent[2] is not None \
-                        and int(sender) == int(ent[2]):
+                        and int(sender) == int(ent[2]) and ent[3] == 1:
                     self.redirector.record(ent[2], now - ent[0])
                 ent[1](rid, body.get("response"), body.get("error"))
         elif k == "rc_client_reply":

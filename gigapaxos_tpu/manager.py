@@ -1121,27 +1121,32 @@ class PaxosManager:
         # log-before-send: persist the promise + accept delta before the
         # blob leaves (bare promises too — a ballot that rose with no
         # accept must survive a crash, ADVICE r1 high / handlePrepare's
-        # LogMessagingTask rule)
+        # LogMessagingTask rule).  The whole tick's blocks (including the
+        # decision log inside _execute) leave as ONE group commit
+        # (BatchedLogger analog) — flushed before this function returns,
+        # so log-before-send still holds for the published blob.
         if self.logger is not None:
-            pg = np.nonzero(out_np.bal_new)[0]
-            if len(pg):
-                bal_np = self._np("bal")
-                self.logger.log_promises(pg.astype(np.int32), bal_np[pg])
-            gs, lanes = np.nonzero(out_np.acc_new)
-            if len(gs):
-                acc_slot = self._np("acc_slot")
-                acc_bal = self._np("acc_bal")
-                acc_vid = self._np("acc_vid")
-                self.logger.log_accepts(
-                    gs.astype(np.int32),
-                    acc_slot[gs, lanes],
-                    acc_bal[gs, lanes],
-                    acc_vid[gs, lanes],
-                )
-            if payload_delta:
-                self.logger.log_payloads(payload_delta, meta=meta_delta)
-
-        self._execute(out_np)
+            with self.logger.batch():
+                pg = np.nonzero(out_np.bal_new)[0]
+                if len(pg):
+                    bal_np = self._np("bal")
+                    self.logger.log_promises(pg.astype(np.int32), bal_np[pg])
+                gs, lanes = np.nonzero(out_np.acc_new)
+                if len(gs):
+                    acc_slot = self._np("acc_slot")
+                    acc_bal = self._np("acc_bal")
+                    acc_vid = self._np("acc_vid")
+                    self.logger.log_accepts(
+                        gs.astype(np.int32),
+                        acc_slot[gs, lanes],
+                        acc_bal[gs, lanes],
+                        acc_vid[gs, lanes],
+                    )
+                if payload_delta:
+                    self.logger.log_payloads(payload_delta, meta=meta_delta)
+                self._execute(out_np)
+        else:
+            self._execute(out_np)
         self._maybe_request_state(out_np)
         self.outstanding.gc()
         if self._tick_no % 64 == 0 and self.inflight:

@@ -16,6 +16,7 @@ array state:
 
 from __future__ import annotations
 
+import contextlib
 import json
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
@@ -73,29 +74,61 @@ class PaxosLogger:
         self.node_id = node_id
         self.dir = directory
         self.journal = Journal(directory, max_file_size=max_file_size, sync=sync)
+        # open group-commit batch (BatchedLogger analog): log_* calls
+        # buffer here and leave in ONE writev/fsync at scope exit
+        self._batch: Optional[List] = None
+
+    @contextlib.contextmanager
+    def batch(self):
+        """Group-commit scope: all log_* appends inside leave together
+        (one writev + at most one fsync).  The scope must close before
+        the tick's blob is published (log-before-send)."""
+        if self._batch is not None:
+            yield  # nested scopes share the outer batch
+            return
+        self._batch = []
+        try:
+            yield
+        finally:
+            blocks, self._batch = self._batch, None
+            if blocks:
+                self.journal.append_many(blocks)
+
+    def _append(self, btype: BlockType, payload: bytes, n_rows: int = 0) -> None:
+        if self._batch is not None:
+            self._batch.append((btype, payload, n_rows))
+        else:
+            self.journal.append(btype, payload, n_rows)
+
+    def _append_columns(self, btype: BlockType, cols) -> None:
+        import numpy as _np
+
+        n = len(cols[0])
+        mat = _np.stack([_np.asarray(c, _np.int32) for c in cols], axis=1)
+        self._append(btype, mat.tobytes(), n_rows=n)
 
     # ---- log-before-send appends --------------------------------------
     def log_accepts(self, groups, slots, bals, vids) -> None:
         if len(groups):
-            self.journal.append_columns(BlockType.ACCEPTS, [groups, slots, bals, vids])
+            self._append_columns(BlockType.ACCEPTS, [groups, slots, bals, vids])
 
     def log_decisions(self, groups, slots, vids) -> None:
         if len(groups):
-            self.journal.append_columns(BlockType.DECISIONS, [groups, slots, vids])
+            self._append_columns(BlockType.DECISIONS, [groups, slots, vids])
 
     def log_promises(self, groups, bals) -> None:
         """Bare promise upgrades (ballot rose without an accept) — must be
         durable before the blob is published, or a restarted acceptor could
         accept an older-ballot proposal it had promised against."""
         if len(groups):
-            self.journal.append_columns(BlockType.PROMISES, [groups, bals])
+            self._append_columns(BlockType.PROMISES, [groups, bals])
 
     def log_create(
         self, groups, masks, versions, coords, names=None, inits=None,
         pendings=None,
     ) -> None:
         if len(groups):
-            self.journal.append_columns(
+            self._append_columns(
                 BlockType.CREATE, [groups, masks, versions, coords]
             )
             if names is not None:
@@ -105,7 +138,7 @@ class PaxosLogger:
                      "pending": bool(pendings[i]) if pendings else False}
                     for i, (g, n, v) in enumerate(zip(groups, names, versions))
                 ]
-                self.journal.append(
+                self._append(
                     BlockType.NAMES,
                     json.dumps(rows, separators=(",", ":")).encode("utf-8"),
                 )
@@ -114,21 +147,21 @@ class PaxosLogger:
         """A pending (pre-COMPLETE) row was confirmed — durably clear the
         propose-refusal gate so recovery doesn't resurrect it."""
         if len(groups):
-            self.journal.append_columns(BlockType.UNPEND, [groups])
+            self._append_columns(BlockType.UNPEND, [groups])
 
     def log_pause(self, record: Dict[str, Any]) -> None:
         """Residency pause record: the group's consensus/app snapshot at
         the moment its row was freed (HotRestoreInfo -> pause table analog,
         ``PaxosManager.java:2307-2348``).  JSON — the window remnants are a
         handful of ints and the app state is a string."""
-        self.journal.append(
+        self._append(
             BlockType.PAUSE,
             json.dumps(record, separators=(",", ":")).encode("utf-8"),
         )
 
     def log_kill(self, groups) -> None:
         if len(groups):
-            self.journal.append_columns(BlockType.KILL, [groups])
+            self._append_columns(BlockType.KILL, [groups])
 
     def log_payloads(
         self, payloads: Dict[int, str], meta: Optional[Dict] = None
@@ -142,7 +175,7 @@ class PaxosLogger:
             if meta:
                 env["m"] = {str(k): list(v) for k, v in meta.items()}
             body = json.dumps(env, separators=(",", ":")).encode("utf-8")
-            self.journal.append(BlockType.PAYLOADS, body)
+            self._append(BlockType.PAYLOADS, body)
 
     # ---- checkpoint ----------------------------------------------------
     def checkpoint(
@@ -151,6 +184,10 @@ class PaxosLogger:
         app_states: Dict[str, Optional[str]],
         extra_meta: Optional[Dict[str, Any]] = None,
     ) -> None:
+        if self._batch:
+            # the snapshot position must cover every buffered block
+            blocks, self._batch = self._batch, []
+            self.journal.append_many(blocks)
         pos = self.journal.position
         meta = dict(extra_meta or {})
         meta["journal_pos"] = list(pos)

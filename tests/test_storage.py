@@ -205,3 +205,53 @@ def test_checkpoint_gcs_journal(tmp_path):
     rec2 = lg.recover(cfg.window)
     assert rec2.arrays["acc_vid"][0, 5 % 4] == 15
     lg.close()
+
+
+def test_native_group_commit_parity(tmp_path):
+    """The native batched append (gp_journal.cc writev group commit,
+    BatchedLogger analog) must produce byte-identical journals to the
+    pure-Python path, readable by the same scanner."""
+    import os
+
+    import numpy as np
+
+    import gigapaxos_tpu.native as nat
+    from gigapaxos_tpu.storage.journal import BlockType, Journal
+
+    blocks = [
+        (BlockType.ACCEPTS,
+         np.arange(12, dtype=np.int32).reshape(3, 4).tobytes(), 3),
+        (BlockType.PAYLOADS, b'{"1":"hello"}', 0),
+        (BlockType.NAMES, b'[{"row":2,"name":"x"}]', 0),
+    ]
+    datas = {}
+    for mode in ("native", "python"):
+        nat._lib = None
+        nat._tried = False
+        if mode == "python":
+            os.environ["GP_NO_NATIVE"] = "1"
+        else:
+            os.environ.pop("GP_NO_NATIVE", None)
+        try:
+            d = str(tmp_path / mode)
+            j = Journal(d)
+            if mode == "native" and j._native is None:
+                import pytest
+
+                pytest.skip("no C++ compiler available")
+            pos = j.append_many(list(blocks))
+            j.append(BlockType.KILL,
+                     np.array([[7]], dtype=np.int32).tobytes(), 1)
+            j.close()
+            j2 = Journal(d)
+            scanned = [(b[0], b[1], b[2]) for b in j2.scan()]
+            j2.close()
+            with open(f"{d}/journal_00000000.bin", "rb") as f:
+                datas[mode] = (pos, scanned, f.read())
+        finally:
+            os.environ.pop("GP_NO_NATIVE", None)
+    nat._lib = None
+    nat._tried = False
+    assert datas["native"][0] == datas["python"][0]  # positions
+    assert datas["native"][1] == datas["python"][1]  # scanned blocks
+    assert datas["native"][2] == datas["python"][2]  # raw bytes
