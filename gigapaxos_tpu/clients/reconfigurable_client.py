@@ -215,6 +215,12 @@ class ReconfigurableAppClient(AsyncFrameClient):
                 time.time(), callback, int(target),
                 (prev[3] + 1) if prev else 1,
             )
+        if prev is not None and prev[2] is not None:
+            # retransmission IS a latency signal: the previous target went
+            # unanswered for the whole interval — record that elapsed time
+            # as a floor sample, or a server slower than the retransmit
+            # interval would never accumulate any RTT evidence at all
+            self.redirector.record(prev[2], time.time() - prev[0])
         self.send_frame(addr, encode_json("client_request", self.my_tag, {
             "name": name, "value": value,
             "request_id": request_id, "stop": stop,

@@ -163,11 +163,18 @@ class Journal:
             pass  # truncate failing leaves the tear; scans still stop
             # cleanly at it and recovery sees everything before _pos
 
-    def append_columns(self, btype: BlockType, cols: List[np.ndarray]) -> Tuple[int, int]:
-        """Append equal-length int32 columns as one packed block."""
+    @staticmethod
+    def pack_columns(cols: List[np.ndarray]) -> Tuple[bytes, int]:
+        """THE packed-column wire encoding (kept in one place: the direct
+        and batched append paths must never diverge from the scanner)."""
         n = len(cols[0])
         mat = np.stack([np.asarray(c, np.int32) for c in cols], axis=1)
-        return self.append(btype, mat.tobytes(), n_rows=n)
+        return mat.tobytes(), n
+
+    def append_columns(self, btype: BlockType, cols: List[np.ndarray]) -> Tuple[int, int]:
+        """Append equal-length int32 columns as one packed block."""
+        payload, n = self.pack_columns(cols)
+        return self.append(btype, payload, n_rows=n)
 
     def append_many(
         self, blocks: List[Tuple[BlockType, bytes, int]]
@@ -187,6 +194,11 @@ class Journal:
         pos = self.position
         for start in range(0, len(blocks), 64):  # native batch cap
             chunk = blocks[start:start + 64]
+            if lib is None or self._native is None:
+                # native path retired mid-batch (repair): finish via Python
+                for btype, payload, n_rows in chunk:
+                    pos = self.append(btype, payload, n_rows)
+                continue
             n = len(chunk)
             btypes = (ctypes.c_uint8 * n)(*[int(b) for b, _, _ in chunk])
             rows = (ctypes.c_uint32 * n)(*[r for _, _, r in chunk])

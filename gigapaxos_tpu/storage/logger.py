@@ -101,11 +101,8 @@ class PaxosLogger:
             self.journal.append(btype, payload, n_rows)
 
     def _append_columns(self, btype: BlockType, cols) -> None:
-        import numpy as _np
-
-        n = len(cols[0])
-        mat = _np.stack([_np.asarray(c, _np.int32) for c in cols], axis=1)
-        self._append(btype, mat.tobytes(), n_rows=n)
+        payload, n = Journal.pack_columns(cols)
+        self._append(btype, payload, n_rows=n)
 
     # ---- log-before-send appends --------------------------------------
     def log_accepts(self, groups, slots, bals, vids) -> None:

@@ -64,3 +64,41 @@ def test_accessors_do_not_transfer_per_call():
         m.is_stopped("svc")
     dt = time.perf_counter() - t0
     assert dt < 1.0, f"30k hot accessor calls took {dt:.2f}s"
+
+
+def test_throughput_survives_lagging_member():
+    """VERDICT r2 weak #7: throughput under lag. With one member's
+    delivery cut, the majority must keep committing at a comparable rate,
+    and the jump-horizon write-off must keep payload retention bounded
+    (a dead member must not pin every payload)."""
+    cfg = EngineConfig(n_groups=8, window=8, req_lanes=4, n_replicas=3)
+
+    def run_commits(drop_member, n_rounds=60):
+        c = ManagerCluster(cfg, NoopPaxosApp)
+        c.create("svc", members=[0, 1, 2])
+        delivery = np.zeros((3, 3), int)
+        if drop_member is not None:
+            delivery[drop_member, :] = 1
+            delivery[:, drop_member] = 1
+        done = {}
+        live = [r for r in range(3) if r != drop_member]
+        for i in range(n_rounds):
+            c.submit("svc", f"v{i}", entry=live[0],
+                     callback=lambda rid, r: done.setdefault(rid, r))
+            c.step_all(delivery=delivery)
+        c.run(10, delivery=delivery)
+        n = len(done)
+        retained = max(len(m.retained) for m in c.managers)
+        c.close()
+        return n, retained
+
+    full, _ = run_commits(None)
+    lagged, retained = run_commits(2)
+    assert lagged >= 0.5 * full, (
+        f"throughput collapsed under a dead member: {lagged} vs {full}"
+    )
+    # retention horizon: the dead member is written off, so payloads do
+    # not accumulate without bound (4W default horizon)
+    assert retained <= 8 * cfg.window, (
+        f"{retained} retained payloads — dead member pins retention"
+    )
