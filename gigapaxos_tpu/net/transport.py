@@ -41,6 +41,8 @@ class MessageTransport:
         listen_host: Optional[str] = None,
         listen_port: Optional[int] = None,
         ssl_context=None,
+        ssl_server_context=None,
+        ssl_client_context=None,
     ):
         self.my_id = int(my_id)
         self.node_config = node_config
@@ -48,7 +50,12 @@ class MessageTransport:
         if listen_host is None or listen_port is None:
             listen_host, listen_port = node_config.get_node_address(my_id)
         self.listen_host, self.listen_port = listen_host, int(listen_port)
-        self._ssl = ssl_context
+        # TLS: a mesh peer both LISTENS and DIALS, and asyncio requires a
+        # TLS_SERVER context on the listener and a TLS_CLIENT context on
+        # outbound connects — one context cannot serve both directions.
+        # `ssl_context` remains as a single-role convenience.
+        self._ssl_server = ssl_server_context or ssl_context
+        self._ssl_client = ssl_client_context or ssl_context
         self._loop = asyncio.new_event_loop()
         self._thread = threading.Thread(
             target=self._loop.run_forever, name=f"transport-{my_id}", daemon=True
@@ -77,8 +84,12 @@ class MessageTransport:
     async def _start_server(self) -> None:
         self._server = await asyncio.start_server(
             self._on_connection, self.listen_host, self.listen_port,
-            ssl=self._ssl,
+            ssl=self._ssl_server,
         )
+        if self.listen_port == 0 and self._server.sockets:
+            # ephemeral bind: report the kernel-chosen port (race-free
+            # alternative to probe-and-rebind in tests/tools)
+            self.listen_port = self._server.sockets[0].getsockname()[1]
 
     def stop(self) -> None:
         if self._stopped:
@@ -201,7 +212,7 @@ class MessageTransport:
                 if writer is None:
                     try:
                         _r, writer = await asyncio.open_connection(
-                            addr[0], addr[1], ssl=self._ssl
+                            addr[0], addr[1], ssl=self._ssl_client
                         )
                         self._writers[addr] = writer
                     except OSError:

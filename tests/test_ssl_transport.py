@@ -1,9 +1,8 @@
 """SSL on the message transport (ref: the nio SSL stack,
-``SSLDataProcessingWorker.java:59`` — SERVER_AUTH mode): the framework's
-transport takes asyncio-native TLS contexts; frames flow over an
-encrypted channel end to end."""
+``SSLDataProcessingWorker.java:59`` — SERVER_AUTH mode): each mesh peer
+listens with a server context and dials with a verifying client context;
+frames flow encrypted in BOTH directions."""
 
-import socket
 import ssl
 import subprocess
 import threading
@@ -25,47 +24,51 @@ def make_cert(tmp_path):
     return str(key), str(crt)
 
 
-def test_tls_frames_end_to_end(tmp_path):
+def test_tls_frames_both_directions(tmp_path):
     from gigapaxos_tpu.net.node_config import NodeConfig
     from gigapaxos_tpu.net.transport import MessageTransport
 
     key, crt = make_cert(tmp_path)
-    server_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
-    server_ctx.load_cert_chain(crt, key)
-    client_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
-    client_ctx.load_verify_locations(crt)
-    client_ctx.check_hostname = False
 
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port_a = s.getsockname()[1]
-    s2 = socket.socket()
-    s2.bind(("127.0.0.1", 0))
-    port_b = s2.getsockname()[1]
-    s.close()
-    s2.close()
+    def contexts():
+        server = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        server.load_cert_chain(crt, key)
+        client = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        client.load_verify_locations(crt)
+        client.check_hostname = False
+        return server, client
 
-    nc = NodeConfig({0: ("127.0.0.1", port_a), 1: ("127.0.0.1", port_b)})
-    got = threading.Event()
-    inbox = []
+    nc = NodeConfig({0: ("127.0.0.1", 0), 1: ("127.0.0.1", 0)})
+    got = {0: threading.Event(), 1: threading.Event()}
+    inbox = {0: [], 1: []}
 
-    def handler_b(payload, peer, reply):
-        inbox.append(payload)
-        got.set()
+    def handler(me):
+        def h(payload, peer, reply):
+            inbox[me].append(payload)
+            got[me].set()
+        return h
 
-    # each side presents the server cert when listening and verifies it
-    # when connecting — asyncio handles both directions of one context
-    # pair (SERVER_AUTH mode analog)
-    ta = MessageTransport(0, nc, lambda *a: None)
-    tb = MessageTransport(1, nc, handler_b)
-    ta._ssl = client_ctx   # outbound connects verify
-    tb._ssl = server_ctx   # inbound listener presents the cert
-    tb.start()
-    ta.start()
+    transports = []
+    for nid in (0, 1):
+        srv_ctx, cli_ctx = contexts()
+        t = MessageTransport(
+            nid, nc, handler(nid),
+            listen_host="127.0.0.1", listen_port=0,  # race-free ephemeral
+            ssl_server_context=srv_ctx, ssl_client_context=cli_ctx,
+        )
+        t.start()
+        nc.add(nid, "127.0.0.1", t.listen_port)  # publish the bound port
+        transports.append(t)
     try:
-        assert ta.send_to_id(1, b"J" + b'{"secret":1}')
-        assert got.wait(10), "TLS frame not delivered"
-        assert inbox[0].endswith(b'{"secret":1}')
+        assert transports[0].send_to_id(1, b"J" + b'{"dir":"0->1"}')
+        assert got[1].wait(10), "0->1 TLS frame not delivered"
+        assert inbox[1][0].endswith(b'{"dir":"0->1"}')
+        # the REVERSE direction: node 1 dials node 0's listener — requires
+        # the server/client context split (one shared context cannot both
+        # present and verify)
+        assert transports[1].send_to_id(0, b"J" + b'{"dir":"1->0"}')
+        assert got[0].wait(10), "1->0 TLS frame not delivered"
+        assert inbox[0][0].endswith(b'{"dir":"1->0"}')
     finally:
-        ta.stop()
-        tb.stop()
+        for t in transports:
+            t.stop()
