@@ -116,40 +116,60 @@ def main() -> None:
     # coordinators are round-robin g % R, matching build_replica_states).
     rids = jnp.arange(R, dtype=jnp.int32)
     groups = jnp.arange(G, dtype=jnp.int32)
-    is_coord = (groups[None, :] % R) == rids[:, None]               # [R, G]
     vids = jnp.arange(1, K + 1, dtype=jnp.int32)  # constant vids; hashed anyway
-    req = jnp.where(is_coord[:, :, None], vids[None, None, :], NULL)  # [R, G, K]
+    # requests offered at EVERY replica's lanes; only the group's ACTIVE
+    # coordinator admits, so this models clients following the leader
+    # (essential under failover churn: a new leader must find requests)
+    req = jnp.broadcast_to(vids[None, None, :], (R, G, K))
     want = jnp.zeros((R, G), dtype=bool)
     step_fn = single_chip_step(cfg)
+
+    # BENCH_MODE=failover (BASELINE config 5): continuous ballot
+    # contention — leadership of every group is forced to rotate around
+    # the replica ring (each group re-elects every ~16 steps, with the
+    # electing 1/16 slice staggered per step), so the measured rate
+    # includes constant preempt/election/carryover churn.
+    failover = os.environ.get("BENCH_MODE", "steady") == "failover"
 
     CHUNK = 10
 
     @jax.jit
-    def run_chunk(states):
-        def body(s, _):
-            s, out = step_fn(s, req, want)
-            return s, out.n_committed[0].sum()  # replica-0 view: each slot once
-        states, committed = jax.lax.scan(body, states, None, length=CHUNK)
+    def run_chunk(states, base):
+        def body(s, i):
+            if failover:
+                t = base + i
+                sl = (groups & jnp.int32(15)) == (t & jnp.int32(15))
+                target = (groups % R + 1 + (t >> 4)) % R
+                w = (target[None, :] == rids[:, None]) & sl[None, :]
+            else:
+                w = want
+            s, out = step_fn(s, req, w)
+            return s, out.n_committed[0].sum()  # each slot once
+        states, committed = jax.lax.scan(
+            body, states, jnp.arange(CHUNK, dtype=jnp.int32)
+        )
         return states, committed.sum()
 
     # Warmup: compile + reach steady state (pipeline fill).
-    states, _ = run_chunk(states)
-    states, c = run_chunk(states)
+    states, _ = run_chunk(states, jnp.int32(0))
+    states, c = run_chunk(states, jnp.int32(CHUNK))
     jax.block_until_ready(c)
 
     t0 = time.perf_counter()
     total = 0
     n_chunks = 5
-    for _ in range(n_chunks):
-        states, c = run_chunk(states)
+    for i in range(n_chunks):
+        states, c = run_chunk(states, jnp.int32((2 + i) * CHUNK))
         total += int(jax.block_until_ready(c))
     dt = time.perf_counter() - t0
 
     rate = total / dt
+    mode = "failover-churn" if failover else "steady-state"
     print(json.dumps({
         "metric": "committed_decisions_per_s",
         "value": round(rate, 1),
-        "unit": f"decisions/s ({G} groups, 3 replicas, 1 chip, {platform})",
+        "unit": f"decisions/s ({G} groups, 3 replicas, 1 chip, "
+                f"{mode}, {platform})",
         "vs_baseline": round(rate / NORTH_STAR, 3),
     }))
 
