@@ -93,7 +93,6 @@ def main() -> None:
 
     import jax.numpy as jnp
 
-    from gigapaxos_tpu.ops.ballot import NULL
     from gigapaxos_tpu.ops.engine import EngineConfig
     from gigapaxos_tpu.parallel.spmd import build_replica_states, single_chip_step
 

@@ -47,6 +47,11 @@ def main() -> int:
     ap.add_argument("--max-rounds", type=int, default=12)
     ap.add_argument("--cpu", action="store_true",
                     help="pin the JAX backend to CPU")
+    ap.add_argument("--in-process", action="store_true",
+                    help="all nodes in this process (default: one OS "
+                         "process per node — the realistic deployment "
+                         "shape; in-process shares one GIL across six "
+                         "tick loops and saturates early)")
     args = ap.parse_args()
 
     if args.cpu:
@@ -57,9 +62,6 @@ def main() -> int:
     from gigapaxos_tpu.clients.reconfigurable_client import (
         ReconfigurableAppClient,
     )
-    from gigapaxos_tpu.models.apps import NoopPaxosApp
-    from gigapaxos_tpu.ops.engine import EngineConfig
-    from gigapaxos_tpu.reconfigurable_node import ReconfigurableNode
     from gigapaxos_tpu.utils.config import Config
 
     ports = free_ports(6)
@@ -67,18 +69,94 @@ def main() -> int:
     for i in range(3):
         Config.set(f"active.AR{i}", f"127.0.0.1:{ports[i]}")
         Config.set(f"reconfigurator.RC{i}", f"127.0.0.1:{ports[3 + i]}")
-    ar_cfg = EngineConfig(
-        n_groups=max(64, args.groups * 2), window=16, req_lanes=8,
-        n_replicas=3,
-    )
-    rc_cfg = EngineConfig(n_groups=16, window=8, req_lanes=4, n_replicas=3)
-    nodes = [
-        ReconfigurableNode(f"{role}{i}", NoopPaxosApp,
-                           ar_cfg=ar_cfg, rc_cfg=rc_cfg)
-        for role in ("AR", "RC") for i in range(3)
-    ]
-    for n in nodes:
-        n.start()
+    node_names = [f"{r}{i}" for r in ("AR", "RC") for i in range(3)]
+    nodes = []
+    procs = []
+    if args.in_process:
+        from gigapaxos_tpu.models.apps import NoopPaxosApp
+        from gigapaxos_tpu.ops.engine import EngineConfig
+        from gigapaxos_tpu.reconfigurable_node import ReconfigurableNode
+
+        ar_cfg = EngineConfig(
+            n_groups=max(64, args.groups * 2), window=16, req_lanes=8,
+            n_replicas=3,
+        )
+        rc_cfg = EngineConfig(n_groups=64, window=16, req_lanes=8,
+                              n_replicas=3)  # match the child default
+        nodes = [
+            ReconfigurableNode(n, NoopPaxosApp, ar_cfg=ar_cfg, rc_cfg=rc_cfg)
+            for n in node_names
+        ]
+        for n in nodes:
+            n.start()
+    else:
+        # one OS process per node (bin/gpServer.sh loopback parity):
+        # properties file + `python -m gigapaxos_tpu.reconfigurable_node`
+        import os
+        import subprocess
+        import tempfile
+
+        props = tempfile.NamedTemporaryFile(
+            "w", suffix=".properties", delete=False
+        )
+        for i in range(3):
+            props.write(f"active.AR{i}=127.0.0.1:{ports[i]}\n")
+            props.write(f"reconfigurator.RC{i}=127.0.0.1:{ports[3 + i]}\n")
+        props.write(f"ENGINE_ROWS={max(64, args.groups * 2)}\n")
+        props.write("SLOT_WINDOW=16\n")
+        # NOTE: child RCs use the node's default rc_cfg (64 rows, window
+        # SLOT_WINDOW); the in-process mode mirrors that below so the two
+        # modes differ only in process topology
+        props.write(
+            "APPLICATION=gigapaxos_tpu.models.apps.NoopPaxosApp\n"
+        )
+        props.close()
+        env = dict(os.environ)
+        env["GIGAPAXOS_CONFIG"] = props.name
+        # six node processes must not fight over one accelerator: the
+        # SYSTEM probe measures the host path, so children always run on
+        # CPU (bench.py owns the chip measurement)
+        env["JAX_PLATFORMS"] = "cpu"
+        err_log = tempfile.NamedTemporaryFile(
+            "w+", suffix=".nodes.log", delete=False
+        )
+        for n in node_names:
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "gigapaxos_tpu.reconfigurable_node", n],
+                env=env, stdout=err_log, stderr=err_log,
+            ))
+        # wait for every listener; fail fast if a child dies
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            dead = [pr for pr in procs if pr.poll() is not None]
+            if dead:
+                break
+            up = 0
+            for p in ports:
+                try:
+                    s_ = socket.create_connection(("127.0.0.1", p), 0.2)
+                    s_.close()
+                    up += 1
+                except OSError:
+                    pass
+            if up == 6:
+                break
+            time.sleep(0.5)
+        else:
+            dead = procs
+        if any(pr.poll() is not None for pr in procs) or (
+            time.time() >= deadline
+        ):
+            for pr in procs:
+                pr.kill()
+            err_log.flush()
+            err_log.seek(0)
+            print(json.dumps({
+                "error": "node processes failed to start",
+                "node_log_tail": err_log.read()[-2000:],
+            }))
+            os.unlink(props.name)
+            return 1
     client = ReconfigurableAppClient.from_properties()
     names = [f"probe{i}" for i in range(args.groups)]
     for nm in names:
@@ -153,6 +231,20 @@ def main() -> int:
         client.close()
         for n in nodes:
             n.stop()
+        for pr in procs:
+            pr.terminate()
+        for pr in procs:
+            try:
+                pr.wait(timeout=10)
+            except Exception:
+                pr.kill()
+        if procs:
+            import os as _os
+
+            try:
+                _os.unlink(props.name)
+            except OSError:
+                pass
         Config.clear()
     return 0
 
