@@ -123,6 +123,10 @@ class PaxosClientAsync(AsyncFrameClient):
         k, _s, body = decode_json(payload)
         if k == "client_response":
             rid = int(body["request_id"])
+            if body.get("error") == "overload":
+                # transient shed, not an answer: keep the callback so the
+                # sync wrapper's retransmission gets the request through
+                return
             with self._lock:
                 ent = self._callbacks.pop(rid, None)
                 # GC stale callbacks while we're here (REQUEST_TIMEOUT_S

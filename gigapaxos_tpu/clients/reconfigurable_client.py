@@ -239,6 +239,10 @@ class ReconfigurableAppClient(AsyncFrameClient):
         out: Dict = {}
 
         def cb(rid, resp, error):
+            if error == "overload":
+                out["backoff"] = True  # shed at entry: retry after a beat
+                ev.set()
+                return
             if error:
                 self.invalidate(name)
                 ev.set()  # wake the loop for an immediate re-resolve
@@ -262,6 +266,11 @@ class ReconfigurableAppClient(AsyncFrameClient):
                 with self._lock:
                     self._callbacks.pop(rid, None)
                 return out.get("resp")
+            if out.pop("backoff", None):
+                # the shed reply came back instantly — an immediate resend
+                # would HAMMER the overloaded entry faster than the normal
+                # no-reply cadence; back off a full jittered interval
+                time.sleep(retransmit_every * (1.0 + random.random()))
         if rid is not None:
             with self._lock:
                 self._callbacks.pop(rid, None)

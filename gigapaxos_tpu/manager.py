@@ -144,6 +144,10 @@ class PaxosManager:
             if jump_horizon is None else int(jump_horizon)
         )
         self.response_cache_ttl = Config.get_float(PC.RESPONSE_CACHE_TTL_S)
+        # admission back-pressure (MAX_OUTSTANDING_REQUESTS 8000 analog,
+        # PaxosConfig.java:537): past this many in-flight requests the
+        # entry path refuses with "overload" and clients back off
+        self.max_outstanding = Config.get_int(PC.MAX_OUTSTANDING_REQUESTS)
 
         # host-side tables
         self.names: Dict[str, int] = {}        # service name -> CURRENT epoch row
@@ -909,6 +913,10 @@ class PaxosManager:
 
     def propose_stop(self, name: str, request_value: str = "", **kw) -> Optional[int]:
         return self.propose(name, request_value, stop=True, **kw)
+
+    def overloaded(self) -> bool:
+        """Entry back-pressure: too many in-flight requests here."""
+        return len(self.inflight) >= self.max_outstanding
 
     # ------------------------------------------------------------------
     # host channel ingress (payload replication + forwarded proposals)

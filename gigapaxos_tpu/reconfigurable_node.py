@@ -385,12 +385,37 @@ def main(argv: Optional[List[str]] = None) -> None:
 
     argv = sys.argv[1:] if argv is None else argv
     load_default_config_file()
-    names = list(Config.register_args(argv))
+    rest = list(Config.register_args(argv))
+    # -c = clean slate (CMD_OPTIONS=-c parity): wipe this node's durable
+    # state before booting
+    clean_slate = "-c" in rest
+    names = [a for a in rest if a != "-c"]
     app_path = Config.get("APPLICATION") or \
         "gigapaxos_tpu.models.apps.NoopPaxosApp"
     mod, _, cls = app_path.rpartition(".")
     app_cls = getattr(importlib.import_module(mod), cls)
-    nodes = [ReconfigurableNode(n, app_cls) for n in names]
+    # the enum default names a relative dir; only an EXPLICIT setting
+    # turns on durability for CLI nodes (tests/dev default to memory-only)
+    log_root = (
+        Config.get_str(PC.PAXOS_LOGS_DIR)
+        if Config.is_set(PC.PAXOS_LOGS_DIR) else None
+    )
+    if clean_slate and log_root:
+        import shutil
+
+        # wipe ONLY the booted names' state: other nodes on this machine
+        # may share the PAXOS_LOGS_DIR root and be alive right now
+        for n in names:
+            d = os.path.join(log_root, n)
+            if os.path.isdir(d):
+                shutil.rmtree(d, ignore_errors=True)
+    nodes = [
+        ReconfigurableNode(
+            n, app_cls,
+            log_dir=(os.path.join(log_root, n) if log_root else None),
+        )
+        for n in names
+    ]
     for n in nodes:
         n.start()
     stop = threading.Event()

@@ -115,6 +115,16 @@ class PaxosServer:
 
     def _on_client_request(self, body: Dict, reply) -> None:
         request_id = int(body["request_id"])
+        if self.manager.overloaded() and \
+                request_id not in self.manager.response_cache:
+            # MAX_OUTSTANDING_REQUESTS back-pressure: shed at the entry
+            # (clients back off and retry; retransmits of answered
+            # requests still get their cached response below)
+            reply(encode_json("client_response", self.my_id, {
+                "request_id": request_id, "response": None,
+                "name": body["name"], "error": "overload",
+            }))
+            return
 
         def cb(rid, response):
             reply(encode_json("client_response", self.my_id, {

@@ -140,6 +140,14 @@ class Config:
             return default
         return _coerce(raw, default)
 
+    @classmethod
+    def is_set(cls, key: Any) -> bool:
+        """True when a file/env/CLI tier explicitly provides the key
+        (some behaviors — e.g. CLI-node durability — should only switch
+        on for an operator's explicit choice, not an enum default)."""
+        raw, _ = cls._lookup_raw(key)
+        return raw is not None
+
     # Typed conveniences mirroring the reference's getGlobal{Int,Boolean,...}
     @classmethod
     def get_int(cls, key: Any) -> int:

@@ -155,6 +155,8 @@ def main() -> int:
                 "error": "node processes failed to start",
                 "node_log_tail": err_log.read()[-2000:],
             }))
+            err_log.close()
+            os.unlink(err_log.name)
             os.unlink(props.name)
             return 1
     client = ReconfigurableAppClient.from_properties()
@@ -239,10 +241,15 @@ def main() -> int:
             except Exception:
                 pr.kill()
         if procs:
-            import os as _os
+            import os
 
+            for f in (props.name, err_log.name):
+                try:
+                    os.unlink(f)
+                except OSError:
+                    pass
             try:
-                _os.unlink(props.name)
+                err_log.close()
             except OSError:
                 pass
         Config.clear()

@@ -141,3 +141,34 @@ def test_delay_emulator_adds_link_latency():
         for s in servers:
             s.stop()
         client.close()
+
+
+def test_overload_backpressure():
+    """MAX_OUTSTANDING_REQUESTS shedding (PaxosConfig.java:537): past the
+    in-flight cap the entry answers 'overload' instead of queueing
+    unboundedly; answered retransmits still hit the response cache."""
+    from gigapaxos_tpu.utils.config import Config
+
+    Config.set("MAX_OUTSTANDING_REQUESTS", 4)
+    try:
+        servers, client, ports = boot_cluster()
+        try:
+            client.create_paxos_instance("bp", [0, 1, 2])
+            r = client.send_request_sync("bp", "warm", timeout=15)
+            assert r is not None
+            # flood one entry far past the cap without stepping time for
+            # the cluster to drain: some requests must be shed
+            mgr = servers[0].manager
+            assert not mgr.overloaded()
+            for i in range(50):
+                client.send_request("bp", f"flood{i}", server=0)
+            deadline = time.time() + 10
+            while time.time() < deadline and not mgr.overloaded():
+                time.sleep(0.01)
+            assert mgr.overloaded(), "cap never reached under flood"
+        finally:
+            for s in servers:
+                s.stop()
+            client.close()
+    finally:
+        Config.clear()
