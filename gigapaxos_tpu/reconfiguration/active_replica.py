@@ -257,9 +257,24 @@ class ActiveReplica:
     # ---- commit (the RC's COMPLETE confirmation of the row) ------------
     def _handle_epoch_commit(self, body: Dict) -> None:
         name, epoch = body["name"], int(body["epoch"])
+        if (
+            self.coordinator.current_epoch(name) != epoch
+            and not self.coordinator.hosts_epoch(name, epoch)
+            and not self.coordinator.has_pause_record(name, epoch)
+        ):
+            # I genuinely never joined this epoch (my start_epoch was lost
+            # and the one-shot late-start round may have expired): NACK so
+            # the re-driven commit round heals my membership.  A paused or
+            # demoted holding of the epoch is NOT missing — a committed
+            # fresh create would clobber its consensus memory.
+            self.send(tuple(body["rc"]), "ack_epoch_commit", {
+                "name": name, "epoch": epoch, "from": self.my_id,
+                "ok": False, "reason": "missing",
+            })
+            return
         self.coordinator.commit_replica_group(name, epoch, body.get("row"))
         self.send(tuple(body["rc"]), "ack_epoch_commit", {
-            "name": name, "epoch": epoch, "from": self.my_id,
+            "name": name, "epoch": epoch, "from": self.my_id, "ok": True,
         })
 
     # ---- stop (handleStopEpoch, ActiveReplica.java:917) ----------------

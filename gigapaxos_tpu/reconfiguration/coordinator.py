@@ -103,6 +103,10 @@ class AbstractReplicaCoordinator:
         """True if this node still holds (name, epoch) — current or demoted."""
         raise NotImplementedError
 
+    def has_pause_record(self, name: str, epoch: int) -> bool:
+        """True if (name, epoch) is paged out here (residency pause)."""
+        raise NotImplementedError
+
     def set_stop_callback(self, cb) -> None:
         """Register cb(name, row, epoch), fired when an epoch-final stop
         executes locally (on every replica)."""
@@ -185,6 +189,9 @@ class PaxosReplicaCoordinator(AbstractReplicaCoordinator):
 
     def hosts_epoch(self, name: str, epoch: int) -> bool:
         return self.manager.epoch_row(name, epoch) is not None
+
+    def has_pause_record(self, name: str, epoch: int) -> bool:
+        return (name, int(epoch)) in self.manager.paused
 
     def set_stop_callback(self, cb) -> None:
         self.manager.on_stop_executed = cb

@@ -222,12 +222,14 @@ class EpochCommitTask(ThresholdProtocolTask):
     max_lifetime_s = 120.0
 
     def __init__(self, key: str, rcf: "Reconfigurator", name: str,
-                 epoch: int, actives: List[int], row: int):
+                 epoch: int, actives: List[int], row: int,
+                 initial_state: Optional[str] = None):
         super().__init__(key, actives, threshold=len(actives))
         self.rcf = rcf
         self.name = name
         self.epoch = epoch
         self.row = row
+        self.initial_state = initial_state
 
     def send_to(self, node):
         # the winning row rides along: a laggard still holding a LOSING
@@ -239,10 +241,37 @@ class EpochCommitTask(ThresholdProtocolTask):
         })
 
     def is_ack(self, kind, body):
-        if kind == "ack_epoch_commit" and body["name"] == self.name \
-                and int(body["epoch"]) == self.epoch:
-            return int(body["from"])
-        return None
+        if kind != "ack_epoch_commit" or body["name"] != self.name \
+                or int(body["epoch"]) != self.epoch:
+            return None
+        if body.get("reason") == "missing":
+            # the member never joined the epoch (its start_epoch was lost
+            # and the one-shot late-start may have expired): heal its
+            # membership here — a committed start re-creates the group.
+            # GUARD: only while the record is STILL at this epoch and
+            # READY — a late retransmit of an old commit round must never
+            # resurrect a dropped epoch as a zombie group on a
+            # migrated-off member.
+            rec = self.rcf.rc_app.get_record(self.name)
+            if rec is None or rec.deleted or rec.epoch != self.epoch \
+                    or rec.state is not RCState.READY \
+                    or int(body["from"]) not in rec.actives:
+                return None
+            # initial state only for the birth epoch; a migrated epoch's
+            # donors may be dropped by now, so the member joins empty and
+            # the straggler state transfer brings it current
+            self.rcf.send(("AR", int(body["from"])), "start_epoch", {
+                "name": self.name, "epoch": self.epoch,
+                "actives": list(self.nodes), "row": self.row,
+                "initial_state": (
+                    self.initial_state if self.epoch == 0 else None
+                ),
+                "prev_actives": [], "prev_epoch": -1,
+                "committed": True,
+                "rc": ["RC", self.rcf.my_id],
+            })
+            return None  # the retransmitted commit confirms after the join
+        return int(body["from"])
 
     def on_threshold(self):
         self.rcf._commit_done.add((self.name, self.epoch))
@@ -787,7 +816,8 @@ class Reconfigurator:
                     self.tasks.spawn_if_not_running(
                         ckey,
                         lambda k=ckey, n=name, r=rec: EpochCommitTask(
-                            k, self, n, r.epoch, r.actives, r.row
+                            k, self, n, r.epoch, r.actives, r.row,
+                            initial_state=r.initial_state,
                         ),
                     )
                 if rec.pending_drop_epoch is not None and \
@@ -960,7 +990,8 @@ class Reconfigurator:
             ckey = f"commit:{name}:{rec.epoch}"
             self.tasks.spawn_if_not_running(
                 ckey, lambda: EpochCommitTask(
-                    ckey, self, name, rec.epoch, rec.actives, rec.row
+                    ckey, self, name, rec.epoch, rec.actives, rec.row,
+                    initial_state=rec.initial_state,
                 )
             )
             laggards = [a for a in rec.actives

@@ -69,8 +69,12 @@ def test_create_request_migrate_delete_over_sockets(cluster):
     assert sorted(ack["actives"]) == [0, 1, 2]
 
     # --- resolve + app requests through epoch 0 ----------------------
-    acts = client.request_actives("svc", timeout=10)
-    assert sorted(acts) == [0, 1, 2]
+    acts = None
+    for _ in range(3):  # the box can be slow under parallel jax compiles
+        acts = client.request_actives("svc", timeout=10, force=True)
+        if acts:
+            break
+    assert acts is not None and sorted(acts) == [0, 1, 2]
     for i in range(5):
         resp = client.send_request_sync("svc", f"r{i}", timeout=20)
         assert resp is not None, f"request r{i} timed out"
@@ -104,8 +108,12 @@ def test_create_request_migrate_delete_over_sockets(cluster):
         client._actives_cache["svc"] = (time.time() + 60, [0])
     resp = client.send_request_sync("svc", "post-migration", timeout=20)
     assert resp is not None, "mid-migration request did not recover"
-    acts = client.request_actives("svc")
-    assert sorted(acts) == [1, 2]
+    acts = None
+    for _ in range(3):
+        acts = client.request_actives("svc", force=True)
+        if acts:
+            break
+    assert acts is not None and sorted(acts) == [1, 2]
 
     # state continuity on the new epoch
     a1 = ar_server(nodes, 1).manager.app

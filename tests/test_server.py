@@ -160,12 +160,34 @@ def test_overload_backpressure():
             # the cluster to drain: some requests must be shed
             mgr = servers[0].manager
             assert not mgr.overloaded()
-            for i in range(50):
-                client.send_request("bp", f"flood{i}", server=0)
-            deadline = time.time() + 10
-            while time.time() < deadline and not mgr.overloaded():
-                time.sleep(0.01)
+            # pause the drain so the flood observation is deterministic
+            # (no-op the tick body; the loop keeps its short cadence so
+            # restoring resumes immediately)
+            saved_ticks = [s_.tick_once for s_ in servers]
+            for s_ in servers:
+                s_.tick_once = lambda: None
+            time.sleep(0.15)  # let in-flight ticks finish
+            for i in range(20):
+                mgr.propose("bp", f"flood{i}")
             assert mgr.overloaded(), "cap never reached under flood"
+            # shed path answers 'overload' while saturated
+            raw_reply = []
+            servers[0]._on_client_request(
+                {"request_id": 999999999, "name": "bp", "value": "x"},
+                lambda frame: raw_reply.append(frame),
+            )
+            assert raw_reply, "no shed reply"
+            from gigapaxos_tpu.net.codec import decode_json
+
+            _k, _s2, body = decode_json(raw_reply[0])
+            assert body.get("error") == "overload", body
+            # resume draining; the queued flood completes
+            for s_, t_ in zip(servers, saved_ticks):
+                s_.tick_once = t_
+            deadline = time.time() + 30
+            while time.time() < deadline and mgr.overloaded():
+                time.sleep(0.05)
+            assert not mgr.overloaded(), "cluster never drained"
         finally:
             for s in servers:
                 s.stop()
