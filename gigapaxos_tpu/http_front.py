@@ -134,9 +134,12 @@ def start_ar_http(
     port: int,
     propose: Callable[[str, str, Callable], Optional[int]],
     timeout_s: float = 20.0,
+    overloaded: Optional[Callable[[], bool]] = None,
 ) -> ThreadingHTTPServer:
     """Mount the active-replica app-request API (HttpActiveReplica analog).
-    ``propose(name, value, callback)`` is the manager's propose."""
+    ``propose(name, value, callback)`` is the manager's propose;
+    ``overloaded()`` gates admission (503) so the MAX_OUTSTANDING back
+    -pressure covers every entry path, not just the binary protocol."""
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):
@@ -167,6 +170,9 @@ def start_ar_http(
             value = payload.get("request", payload.get("value"))
             if not name or value is None:
                 self._respond(400, {"error": "need name and request"})
+                return
+            if overloaded is not None and overloaded():
+                self._respond(503, {"error": "overload", "name": name})
                 return
             ev = threading.Event()
             box: Dict = {}

@@ -708,7 +708,7 @@ class PaxosManager:
 
     def resume_group(
         self, name: str, epoch: int, members: List[int], row: int,
-        pending: bool = True,
+        pending: bool = True, initial_state: Optional[str] = None,
     ) -> bool:
         """Reactivate (name, epoch) at `row` (the RC's freshly probed row).
 
@@ -740,10 +740,11 @@ class PaxosManager:
                     f"row {row} already hosts {self.row_name[int(row)]!r}"
                 )
             if rec is None:
-                # no local state at all: join empty and heal via state
-                # transfer once the group runs
+                # no local state at all: join with the birth state (if
+                # the caller knows it) and heal via state transfer once
+                # the group runs
                 return self._create_locked(
-                    name, members, None, epoch, int(row), pending
+                    name, members, initial_state, epoch, int(row), pending
                 )
             ok = self._create_locked(
                 name, members, rec.get("app_state"), epoch, int(row), pending

@@ -115,6 +115,7 @@ class ActiveReplicaServer(PaxosServer):
                 lambda name, value, cb: self.manager.propose(
                     name, value, callback=cb
                 ),
+                overloaded=self.manager.overloaded,
             )
         except OSError:
             pass  # HTTP port taken: binary protocol still fully serves

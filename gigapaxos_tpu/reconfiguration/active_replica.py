@@ -236,6 +236,7 @@ class ActiveReplica:
                     body["name"], int(body["epoch"]), list(body["actives"]),
                     int(body["row"]),
                     pending=not body.get("committed", False),
+                    initial_state=body.get("initial_state"),
                 )
             else:
                 ok = self.coordinator.create_replica_group(
@@ -256,25 +257,34 @@ class ActiveReplica:
 
     # ---- commit (the RC's COMPLETE confirmation of the row) ------------
     def _handle_epoch_commit(self, body: Dict) -> None:
+        """Ack ok ONLY when this member truly runs the current epoch at
+        the winning row — an ok over a silent no-op would complete the
+        commit round with this member still pending / paused / missing,
+        and nothing would ever heal it.  The NACK drives the RC's heal
+        (a committed RESUME start, which uniformly re-homes a losing
+        pending row, restores a pause record, or joins empty)."""
         name, epoch = body["name"], int(body["epoch"])
-        if (
-            self.coordinator.current_epoch(name) != epoch
-            and not self.coordinator.hosts_epoch(name, epoch)
-            and not self.coordinator.has_pause_record(name, epoch)
-        ):
-            # I genuinely never joined this epoch (my start_epoch was lost
-            # and the one-shot late-start round may have expired): NACK so
-            # the re-driven commit round heals my membership.  A paused or
-            # demoted holding of the epoch is NOT missing — a committed
-            # fresh create would clobber its consensus memory.
+        cur = self.coordinator.current_epoch(name)
+        row = body.get("row")
+        if cur is not None and cur > epoch:
+            # historic round for a superseded epoch: nothing to confirm
             self.send(tuple(body["rc"]), "ack_epoch_commit", {
-                "name": name, "epoch": epoch, "from": self.my_id,
-                "ok": False, "reason": "missing",
+                "name": name, "epoch": epoch, "from": self.my_id, "ok": True,
             })
             return
-        self.coordinator.commit_replica_group(name, epoch, body.get("row"))
+        hosted_row = self.coordinator.epoch_row_of(name, epoch)
+        if cur == epoch and (row is None or hosted_row == int(row)):
+            self.coordinator.commit_replica_group(name, epoch, row)
+            self.send(tuple(body["rc"]), "ack_epoch_commit", {
+                "name": name, "epoch": epoch, "from": self.my_id, "ok": True,
+            })
+            return
+        # not running the winning row of this epoch in any live form:
+        # missing entirely, paused, stuck at a losing pending row, or
+        # never started — all healed by the RC's committed resume
         self.send(tuple(body["rc"]), "ack_epoch_commit", {
-            "name": name, "epoch": epoch, "from": self.my_id, "ok": True,
+            "name": name, "epoch": epoch, "from": self.my_id,
+            "ok": False, "reason": "missing",
         })
 
     # ---- stop (handleStopEpoch, ActiveReplica.java:917) ----------------

@@ -68,10 +68,11 @@ class AbstractReplicaCoordinator:
 
     def resume_replica_group(
         self, name: str, epoch: int, members: List[int], row: int,
-        pending: bool = True,
+        pending: bool = True, initial_state=None,
     ) -> bool:
         """Residency: reactivate at a freshly probed row (raises on a row
-        collision, like create)."""
+        collision, like create).  ``initial_state`` seeds a member with no
+        local state joining a BIRTH epoch."""
         raise NotImplementedError
 
     def idle_groups(self, idle_s: float):
@@ -105,6 +106,10 @@ class AbstractReplicaCoordinator:
 
     def has_pause_record(self, name: str, epoch: int) -> bool:
         """True if (name, epoch) is paged out here (residency pause)."""
+        raise NotImplementedError
+
+    def epoch_row_of(self, name: str, epoch: int):
+        """The engine row hosting (name, epoch) here, or None."""
         raise NotImplementedError
 
     def set_stop_callback(self, cb) -> None:
@@ -163,10 +168,11 @@ class PaxosReplicaCoordinator(AbstractReplicaCoordinator):
 
     def resume_replica_group(
         self, name: str, epoch: int, members: List[int], row: int,
-        pending: bool = True,
+        pending: bool = True, initial_state=None,
     ) -> bool:
         return self.manager.resume_group(
-            name, epoch, members, row, pending=pending
+            name, epoch, members, row, pending=pending,
+            initial_state=initial_state,
         )
 
     def idle_groups(self, idle_s: float):
@@ -192,6 +198,9 @@ class PaxosReplicaCoordinator(AbstractReplicaCoordinator):
 
     def has_pause_record(self, name: str, epoch: int) -> bool:
         return (name, int(epoch)) in self.manager.paused
+
+    def epoch_row_of(self, name: str, epoch: int):
+        return self.manager.epoch_row(name, epoch)
 
     def set_stop_callback(self, cb) -> None:
         self.manager.on_stop_executed = cb

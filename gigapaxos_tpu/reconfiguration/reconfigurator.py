@@ -257,9 +257,10 @@ class EpochCommitTask(ThresholdProtocolTask):
                     or rec.state is not RCState.READY \
                     or int(body["from"]) not in rec.actives:
                 return None
-            # initial state only for the birth epoch; a migrated epoch's
-            # donors may be dropped by now, so the member joins empty and
-            # the straggler state transfer brings it current
+            # RESUME semantics heal every missing shape uniformly: a
+            # losing pending row re-homes with its held queue, a pause
+            # record restores, and a member with no state joins empty
+            # (resume_group's fallback) and heals via state transfer.
             self.rcf.send(("AR", int(body["from"])), "start_epoch", {
                 "name": self.name, "epoch": self.epoch,
                 "actives": list(self.nodes), "row": self.row,
@@ -267,14 +268,16 @@ class EpochCommitTask(ThresholdProtocolTask):
                     self.initial_state if self.epoch == 0 else None
                 ),
                 "prev_actives": [], "prev_epoch": -1,
-                "committed": True,
+                "resume": True, "committed": True,
                 "rc": ["RC", self.rcf.my_id],
             })
             return None  # the retransmitted commit confirms after the join
         return int(body["from"])
 
     def on_threshold(self):
-        self.rcf._commit_done.add((self.name, self.epoch))
+        # keyed by ROW as well: a reactivation keeps the epoch but moves
+        # the row, and its commit round must be re-drivable independently
+        self.rcf._commit_done.add((self.name, self.epoch, self.row))
         return ()
 
 
@@ -811,7 +814,7 @@ class Reconfigurator:
                             ),
                         })
                         continue
-                if (name, rec.epoch) not in self._commit_done:
+                if (name, rec.epoch, rec.row) not in self._commit_done:
                     ckey = f"commit:{name}:{rec.epoch}"
                     self.tasks.spawn_if_not_running(
                         ckey,
@@ -909,9 +912,10 @@ class Reconfigurator:
                         row=rw,
                     ),
                 )
-        # confirmed-commit entries for purged records / superseded epochs
+        # confirmed-commit entries for purged records / superseded
+        # epochs / moved rows
         self._commit_done &= {
-            (n, r.epoch) for n, r in self.rc_app.records.items()
+            (n, r.epoch, r.row) for n, r in self.rc_app.records.items()
         }
 
     # ------------------------------------------------------------------

@@ -1,0 +1,23 @@
+"""Ephemeral-port reservation for loopback clusters.
+
+A cluster's address book must be complete before any node starts, so the
+transport's bind-port-0-and-read-back path can't be used — instead probe
+N free ports up front (with the inherent small race; tests retry at a
+higher level if a port is stolen between close and bind)."""
+
+from __future__ import annotations
+
+import socket
+from typing import List
+
+
+def free_ports(n: int) -> List[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports

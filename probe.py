@@ -16,22 +16,13 @@ and a final summary line.
 
 import argparse
 import json
+import os
 import socket
 import sys
 import threading
 import time
 
-
-def free_ports(n):
-    socks, ports = [], []
-    for _ in range(n):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        socks.append(s)
-        ports.append(s.getsockname()[1])
-    for s in socks:
-        s.close()
-    return ports
+from gigapaxos_tpu.testing.ports import free_ports
 
 
 def main() -> int:
@@ -92,7 +83,6 @@ def main() -> int:
     else:
         # one OS process per node (bin/gpServer.sh loopback parity):
         # properties file + `python -m gigapaxos_tpu.reconfigurable_node`
-        import os
         import subprocess
         import tempfile
 
@@ -241,8 +231,6 @@ def main() -> int:
             except Exception:
                 pr.kill()
         if procs:
-            import os
-
             for f in (props.name, err_log.name):
                 try:
                     os.unlink(f)

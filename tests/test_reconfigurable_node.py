@@ -9,6 +9,8 @@ from a stale actives cache mid-migration."""
 import socket
 import time
 
+from gigapaxos_tpu.testing.ports import free_ports
+
 import pytest
 
 from gigapaxos_tpu.clients.reconfigurable_client import ReconfigurableAppClient
@@ -16,18 +18,6 @@ from gigapaxos_tpu.models.apps import HashChainApp
 from gigapaxos_tpu.ops.engine import EngineConfig
 from gigapaxos_tpu.reconfigurable_node import ReconfigurableNode
 from gigapaxos_tpu.utils.config import Config
-
-
-def free_ports(n):
-    socks, ports = [], []
-    for _ in range(n):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        socks.append(s)
-        ports.append(s.getsockname()[1])
-    for s in socks:
-        s.close()
-    return ports
 
 
 @pytest.fixture(scope="module")
