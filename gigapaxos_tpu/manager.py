@@ -143,7 +143,12 @@ class PaxosManager:
             Config.get_int(PC.JUMP_HORIZON_WINDOWS) * cfg.window
             if jump_horizon is None else int(jump_horizon)
         )
+        # exactly-once dedup window: like the reference's TTL'd
+        # GCConcurrentHashMap (PaxosManager.java:318-346), dedup is
+        # guaranteed only within the cache's TTL+size window — a duplicate
+        # re-proposal arriving after eviction can re-execute
         self.response_cache_ttl = Config.get_float(PC.RESPONSE_CACHE_TTL_S)
+        self.response_cache_cap = Config.get_int(PC.RESPONSE_CACHE_SIZE)
         # admission back-pressure (MAX_OUTSTANDING_REQUESTS 8000 analog,
         # PaxosConfig.java:537): past this many in-flight requests the
         # entry path refuses with "overload" and clients back off
@@ -228,6 +233,12 @@ class PaxosManager:
         self.total_executed = 0
         self._slots_since_ckpt = 0
         self._last_state_req: Dict[int, int] = {}  # row -> tick of last pull
+        # rows whose app cursor is parked on a missing payload, and since
+        # which tick: a payload GONE everywhere (GC'd before this member
+        # joined) can park a cursor at a gap SMALLER than the ring/jump
+        # horizons — after enough blocked ticks the state pull fires
+        # regardless of gap size
+        self._payload_blocked: Dict[int, Tuple[int, int]] = {}
 
         # serializes self.state replacement between the tick loop and
         # lifecycle ops arriving on transport threads (create/kill/recover)
@@ -526,6 +537,7 @@ class PaxosManager:
                 # subsumes any of the old row's decided-but-unexecuted slots;
                 # executing them after the restore would double-apply them.
                 self.pending_exec.pop(cur_row, None)
+                self._payload_blocked.pop(cur_row, None)
                 self.app_exec_slot[cur_row] = int(
                     self._np("exec_slot")[cur_row]
                 )
@@ -594,6 +606,7 @@ class PaxosManager:
             return False
         del self.row_name[row]
         self.pending_rows.discard(row)
+        self._payload_blocked.pop(row, None)
         self.state = kill_groups(self.state, np.array([row]))
         if self.logger:
             self.logger.log_kill(np.array([row]))
@@ -629,6 +642,7 @@ class PaxosManager:
                 return self._kill_locked(name)
             del self.row_name[row]
             self.pending_rows.discard(row)
+            self._payload_blocked.pop(row, None)
             self.state = kill_groups(self.state, np.array([row]))
             if self.logger:
                 self.logger.log_kill(np.array([row]))
@@ -1230,16 +1244,28 @@ class PaxosManager:
             pend = self.pending_exec[g]
             name = self.row_name.get(g)
             cursor = int(self.app_exec_slot[g])
+            blocked = False
             while cursor in pend:
                 vid = pend[cursor]
                 if not self._execute_one(name, g, cursor, vid):
                     missing.append(vid)
+                    blocked = True
                     break  # payload not here yet; pull + retry next tick
                 del pend[cursor]
                 cursor += 1
             if cursor != int(self.app_exec_slot[g]):
                 self.app_exec_slot[g] = cursor
                 self._app_exec_dirty.add(g)
+            if blocked:
+                # (re)start the timer whenever the parked SLOT changes:
+                # only a cursor truly stuck at one slot should trip the
+                # pull — a straggler making net progress through payload
+                # pulls is healing normally
+                ent = self._payload_blocked.get(g)
+                if ent is None or ent[1] != cursor:
+                    self._payload_blocked[g] = (self._tick_no, cursor)
+            else:
+                self._payload_blocked.pop(g, None)
             if not pend:
                 del self.pending_exec[g]
         return missing
@@ -1293,6 +1319,15 @@ class PaxosManager:
                 pass  # reconfiguration-layer hook must not wedge execution
         response = getattr(req, "response_value", None)
         self.response_cache[request_id] = (time.time(), response)
+        if len(self.response_cache) > self.response_cache_cap:
+            # size bound (RESPONSE_CACHE_SIZE analog): evict the oldest
+            # tenth so the cache (and its state-transfer ride-along)
+            # stays bounded under sustained load between checkpoint GCs
+            by_age = sorted(
+                self.response_cache.items(), key=lambda kv: kv[1][0]
+            )
+            for rid, _ in by_age[: max(1, len(by_age) // 10)]:
+                del self.response_cache[rid]
         if entry == self.my_id:
             cb = self.outstanding.pop(request_id)
             if cb is not None:
@@ -1305,6 +1340,7 @@ class PaxosManager:
     # PaxosInstanceStateMachine.java:1744; jumpSlot, PaxosAcceptor.java:538)
     # ------------------------------------------------------------------
     STATE_REQ_INTERVAL = 16  # ticks between pulls for the same row
+    PAYLOAD_BLOCKED_TICKS = 64  # parked-on-missing-payload pull trigger
 
     def _maybe_request_state(self, out_np) -> None:
         """Detect rows needing a state pull: (a) device frontier stranded
@@ -1312,12 +1348,19 @@ class PaxosManager:
         [G, W] ring (the SyncDecisionsPacket 'isMissingTooMuch' case), or
         (b) the APP cursor stranded behind the local device frontier past
         the retention horizon — the payloads it needs were GC'd everywhere
-        (only the app state + cursor need transfer, not an engine jump)."""
+        (only the app state + cursor need transfer, not an engine jump),
+        or (c) the cursor parked on a missing payload for many ticks at
+        ANY gap size — a short-history group whose payloads were GC'd
+        before this member joined fits under both horizons yet can never
+        execute its way forward."""
         W = self.cfg.window
         exec_np = self._np("exec_slot")
         behind_dev = (out_np.maj_exec - exec_np) > W
         behind_app = (exec_np - self.app_exec_slot) > self.jump_horizon
         need = behind_dev | behind_app
+        for g, (t0, _slot) in self._payload_blocked.items():
+            if self._tick_no - t0 > self.PAYLOAD_BLOCKED_TICKS:
+                need[g] = True
         if not need.any():
             return
         versions = self._np("version")
@@ -1375,20 +1418,19 @@ class PaxosManager:
                 state=self.app.checkpoint(name),
             ).to_json())
         if states:
-            # Response-cache entries for the served rows ride along:
-            # without them the receiver cannot dedup a duplicate decision
-            # (same request id, different vid) landing after its jumped
-            # frontier — replicas that executed the first copy skip it, a
-            # jumped replica would execute it and diverge.  Filtered to the
-            # requested rows via the retained-payload index (the unfiltered
-            # cache spans every group).
-            served = {int(s["row"]) for s in states}
-            cache = {}
-            for vid, (row, _slot) in self.retained.items():
-                if row in served and vid in self.vid_meta:
-                    rid = self.vid_meta[vid][1]
-                    if rid in self.response_cache:
-                        cache[str(rid)] = self.response_cache[rid][1]
+            # The FULL (TTL+size-bounded) response cache rides along:
+            # without these entries the receiver cannot dedup a duplicate
+            # decision (same request id, different vid) landing after its
+            # jumped frontier — replicas that executed the first copy skip
+            # it, a jumped replica would execute it and DIVERGE the RSM.
+            # Filtering by the retained-payload index proved unsound: a
+            # re-proposed duplicate's first execution can predate payload
+            # GC, leaving the one dedup entry that matters out of the
+            # filter (caught by the chaos soak).
+            cache = {
+                str(rid): [t, resp]
+                for rid, (t, resp) in self.response_cache.items()
+            }
             self.forward_out.append(
                 (body["from"], "state_reply",
                  {"states": states, "response_cache": cache})
@@ -1453,14 +1495,22 @@ class PaxosManager:
                 np.array([e["stopped"] for e in jumps]),
             )
         now = time.time()
-        for rid_s, resp in (response_cache or {}).items():
-            self.response_cache.setdefault(int(rid_s), (now, resp))
+        for rid_s, ent in (response_cache or {}).items():
+            if isinstance(ent, (list, tuple)):
+                t, resp = float(ent[0]), ent[1]
+            else:  # legacy shape: bare response
+                t, resp = now, ent
+            # keep the DONOR's age: restamping as fresh would make this
+            # replica's eviction order diverge from its peers' far more
+            # than clock skew does (dedup sets must stay aligned)
+            self.response_cache.setdefault(int(rid_s), (min(t, now), resp))
         for ent in jumps:
             g = int(ent["row"])
             self.app.restore(ent["name"], ent["app_state"])
             self.app_exec_slot[g] = int(ent["exec"])
             self._app_exec_dirty.add(g)
             self.pending_exec.pop(g, None)
+            self._payload_blocked.pop(g, None)
             if int(ent["stopped"]) and self.on_stop_executed is not None:
                 # the STOP decision will never execute locally (the jump
                 # landed past it) — fire the hook now so the epoch layer
@@ -1476,6 +1526,7 @@ class PaxosManager:
             self.app.restore(ent["name"], ent["app_state"])
             self.app_exec_slot[g] = int(ent["exec"])
             self._app_exec_dirty.add(g)
+            self._payload_blocked.pop(g, None)
             pend = self.pending_exec.get(g)
             if pend:  # decisions at/past the adopted cursor still execute
                 for slot in [s for s in pend if s < int(ent["exec"])]:

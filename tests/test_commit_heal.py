@@ -24,7 +24,7 @@ def make_ar() -> Tuple[ActiveReplica, PaxosManager, List]:
     return ar, mgr, sent
 
 
-def commit(ar, name, epoch, row) -> Dict:
+def commit(ar, name, epoch, row) -> None:
     ar.handle_message("epoch_commit", {
         "name": name, "epoch": epoch, "row": row, "rc": ["RC", 0],
     })
@@ -67,8 +67,6 @@ def test_ack_matrix():
     mgr.create_paxos_instance("d", [0, 1, 2], row=6)
     mgr.propose_stop("d")
     # simulate the stop having executed so the epoch can move on
-    import numpy as np
-
     st = mgr.state
     mgr.state = st._replace(stopped=st.stopped.at[6].set(1))
     mgr.create_paxos_instance("d", [0, 1, 2], row=7, version=1)

@@ -59,8 +59,16 @@ def test_create_request_migrate_delete_over_sockets(cluster):
     assert sorted(ack["actives"]) == [0, 1, 2]
 
     # --- resolve + app requests through epoch 0 ----------------------
+    # under a loaded box the 6 in-process nodes can stall tens of seconds
+    # on cold jax compiles; wait on the record itself before resolving
+    deadline = time.time() + 90
+    while time.time() < deadline:
+        rec = nodes[3].servers[0].rc_app.get_record("svc")
+        if rec is not None and rec.actives:
+            break
+        time.sleep(0.25)
     acts = None
-    for _ in range(3):  # the box can be slow under parallel jax compiles
+    for _ in range(6):
         acts = client.request_actives("svc", timeout=10, force=True)
         if acts:
             break
