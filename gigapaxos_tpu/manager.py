@@ -205,7 +205,16 @@ class PaxosManager:
         # same decided sequence, so skipping re-execution of a seen id is
         # deterministic across the group — at-least-once commit,
         # exactly-once execution; ref: PaxosManager.java:318-346).
-        self.response_cache: Dict[int, Tuple[float, Optional[str]]] = {}
+        # request_id -> (time, response, name-of-execution).  The name
+        # tag makes state-transfer dedup SOUND: a donor ships only
+        # entries executed in the groups whose app state it serves — an
+        # entry for any other group would suppress an execution the
+        # receiver's state does not contain, while OMITTING an entry the
+        # adopted state does contain lets a re-proposed duplicate
+        # re-execute; both directions diverge the RSM (each was caught
+        # by the chaos soak).  Names, not rows: the tag must survive
+        # migrations that re-home a name to a new row.
+        self.response_cache: Dict[int, Tuple[float, Optional[str], str]] = {}
         # in-flight dedup (the reference's outstanding-table propose dedup,
         # PaxosManager.java:1209): a retransmitted request id whose original
         # proposal is still queued locally must not mint a second vid —
@@ -213,6 +222,12 @@ class PaxosManager:
         # and post-jump replicas can't dedup them (no cache entry yet)
         self.inflight: Dict[int, int] = {}  # request_id -> queued vid
         self._next_counter = 1
+        # node-minted request-id namespace: (boot nonce << 24) | counter,
+        # < 2^61 (disjoint from reserved-bit-62 stop ids; client ids are
+        # random 53+ bit — collision odds negligible either way)
+        import random as _random
+
+        self._rid_nonce = _random.randrange(1 << 20, 1 << 37)
         self.queues: Dict[int, List[int]] = {}  # group row -> pending vids
         self.forward_out: List[Tuple[int, str, Dict]] = []  # (dst, kind, body)
         self._fired_callbacks: List[Tuple[Callable, int, Optional[str]]] = []
@@ -239,6 +254,13 @@ class PaxosManager:
         # horizons — after enough blocked ticks the state pull fires
         # regardless of gap size
         self._payload_blocked: Dict[int, Tuple[int, int]] = {}
+        # rows that joined an epoch > 0 WITHOUT state (membership heal /
+        # resume fallback): their logical app state is the previous
+        # epoch's final state, which no frontier counter reflects — with
+        # zero post-join traffic the frontiers MATCH and the ordinary
+        # straggler pull never fires.  Flagged rows pull state and adopt
+        # a donor's app state even at EQUAL frontiers.
+        self._needs_state: set = set()
 
         # serializes self.state replacement between the tick loop and
         # lifecycle ops arriving on transport threads (create/kill/recover)
@@ -291,6 +313,12 @@ class PaxosManager:
         self.arena.update(rec.payloads)  # journal blocks are newer
         for k, v in rec.payload_meta.items():
             self.vid_meta.setdefault(int(k), (int(v[0]), int(v[1])))
+        for rid_s, ent in (meta.get("response_cache") or {}).items():
+            # exactly-once dedup survives restarts (the restored app
+            # state's history includes these executions)
+            self.response_cache.setdefault(
+                int(rid_s), (float(ent[0]), ent[1], str(ent[2]))
+            )
         self.names = {str(k): int(v) for k, v in meta.get("names", {}).items()}
         self.old_epochs = {
             (str(n), int(e)): int(r)
@@ -327,6 +355,18 @@ class PaxosManager:
         self.pending_rows = {
             int(r) for r in rec.pending_rows if r in live_rows
         }
+        # blank-join rows still awaiting a donor's state survive restarts:
+        # seed from the checkpoint meta, plus infer journal-replayed
+        # creates at epoch > 0 with no initial state (a legit None final
+        # state just costs one redundant pull that adopts the same None)
+        self._needs_state = {
+            int(r) for r in (meta.get("needs_state") or [])
+            if int(r) in live_rows
+        }
+        for nm, init in journal_inits.items():
+            r = self.names.get(nm)
+            if r is not None and init is None and int(versions[r]) > 0:
+                self._needs_state.add(r)
         self._next_counter = int(meta.get("next_counter", 1))
         for vid in rec.payloads:
             base = vid & ~STOP_BIT
@@ -398,6 +438,10 @@ class PaxosManager:
                     self.app.restore(nm, prec.get("app_state"))
                     self.app_exec_slot[r] = int(prec["exec"])
                     self.pending_exec.pop(r, None)
+                    for rid_s, ent in (prec.get("dedup") or {}).items():
+                        self.response_cache.setdefault(
+                            int(rid_s), (float(ent[0]), ent[1], str(ent[2]))
+                        )
             elif nm not in self.names:
                 self.paused[(nm, e)] = prec
         # Roll the execute frontier forward through EVERY journaled
@@ -538,6 +582,7 @@ class PaxosManager:
                 # executing them after the restore would double-apply them.
                 self.pending_exec.pop(cur_row, None)
                 self._payload_blocked.pop(cur_row, None)
+                self._needs_state.discard(cur_row)
                 self.app_exec_slot[cur_row] = int(
                     self._np("exec_slot")[cur_row]
                 )
@@ -607,6 +652,7 @@ class PaxosManager:
         del self.row_name[row]
         self.pending_rows.discard(row)
         self._payload_blocked.pop(row, None)
+        self._needs_state.discard(row)
         self.state = kill_groups(self.state, np.array([row]))
         if self.logger:
             self.logger.log_kill(np.array([row]))
@@ -643,6 +689,7 @@ class PaxosManager:
             del self.row_name[row]
             self.pending_rows.discard(row)
             self._payload_blocked.pop(row, None)
+            self._needs_state.discard(row)
             self.state = kill_groups(self.state, np.array([row]))
             if self.logger:
                 self.logger.log_kill(np.array([row]))
@@ -718,6 +765,7 @@ class PaxosManager:
             "app_state": self.app.checkpoint(name),
             "app_exec": int(self.app_exec_slot[row]),
             "acc": acc, "dec": dec,
+            "dedup": self.dedup_for_name(name),
         }
 
     def resume_group(
@@ -755,11 +803,16 @@ class PaxosManager:
                 )
             if rec is None:
                 # no local state at all: join with the birth state (if
-                # the caller knows it) and heal via state transfer once
-                # the group runs
-                return self._create_locked(
+                # the caller knows it) and heal via state transfer
+                ok = self._create_locked(
                     name, members, initial_state, epoch, int(row), pending
                 )
+                if ok and epoch > 0 and initial_state is None:
+                    # an epoch > 0 group's true app state is the previous
+                    # epoch's final state — this join is BLANK and must
+                    # adopt a donor's state even at equal frontiers
+                    self._needs_state.add(int(row))
+                return ok
             ok = self._create_locked(
                 name, members, rec.get("app_state"), epoch, int(row), pending
             )
@@ -790,6 +843,7 @@ class PaxosManager:
             )
             self.app_exec_slot[r] = int(rec.get("app_exec", rec["exec"]))
             self._app_exec_dirty.add(r)
+            self.install_dedup(rec.get("dedup"))
             # the _create_locked journal entry has the app state as init;
             # the consensus remnants need the pause record on replay too
             if self.logger:
@@ -799,6 +853,28 @@ class PaxosManager:
                 self.queues[r] = [v for v in held if v in self.arena]
             self.row_activity[r] = time.time()
             return True
+
+    def dedup_for_name(self, name: str) -> Dict[str, list]:
+        """This name's exactly-once entries, for shipping WITH any app
+        -state handoff (epoch final state, pause record, state transfer):
+        an adopted state without its dedup entries re-executes re-proposed
+        duplicates; entries for other names suppress executions the
+        adopted state lacks — both diverge the RSM."""
+        with self._state_lock:
+            return {
+                str(rid): [t, resp, nm]
+                for rid, (t, resp, nm) in self.response_cache.items()
+                if nm == name
+            }
+
+    def install_dedup(self, entries: Optional[Dict]) -> None:
+        now = time.time()
+        with self._state_lock:
+            for rid_s, ent in (entries or {}).items():
+                self.response_cache.setdefault(
+                    int(rid_s),
+                    (min(float(ent[0]), now), ent[1], str(ent[2])),
+                )
 
     def drain_demand(self) -> Dict[str, Tuple[int, int]]:
         """Take the per-name request counts since the last drain; returns
@@ -908,7 +984,14 @@ class PaxosManager:
                 vid = (self.my_id << VID_NODE_SHIFT) | self._next_counter
                 self._next_counter += 1
                 if request_id is None:
-                    request_id = vid  # namespaced-unique by construction
+                    # boot-unique: the bare vid counter RESETS across
+                    # restarts when its vid was forwarded away before
+                    # being journaled, and a reused id collides with the
+                    # now-persistent dedup entries of pre-restart
+                    # requests (misread as duplicates — chaos-soak find)
+                    request_id = (self._rid_nonce << 24) | (
+                        vid & VID_COUNTER_MASK
+                    )
                 if stop:
                     vid |= STOP_BIT
                 self.arena[vid] = request_value
@@ -973,6 +1056,15 @@ class PaxosManager:
                 else:  # dense snapshot (legacy peers)
                     np.maximum(arr, np.asarray(cursors, np.int64), out=arr)
         elif kind == "forward":  # a peer forwards a proposal to me
+            fwd_epoch = body.get("epoch")
+            if fwd_epoch is not None and (
+                self.current_epoch(body["name"]) != int(fwd_epoch)
+            ):
+                # a DELAYED forward from a superseded epoch must not be
+                # injected into the current one — an old epoch's stop
+                # executing in the new epoch diverges the RSM (chaos
+                # soak); genuine client requests retransmit
+                return
             self.propose(
                 body["name"], body["value"],
                 stop=body.get("stop", False),
@@ -1023,6 +1115,7 @@ class PaxosManager:
                 if name is None:
                     vids.clear()
                     continue
+                epoch_now = int(self._np("version")[row])
                 for vid in vids:
                     entry, rid = self.vid_meta.get(vid, (self.my_id, vid))
                     self.forward_out.append((coord, "forward", {
@@ -1031,6 +1124,7 @@ class PaxosManager:
                         "stop": bool(vid & STOP_BIT),
                         "request_id": rid,
                         "entry": entry,
+                        "epoch": epoch_now,
                     }))
                     # the coordinator re-mints its own vid; our local copy
                     # would only go stale (the callback stays in
@@ -1318,11 +1412,14 @@ class PaxosManager:
             except Exception:
                 pass  # reconfiguration-layer hook must not wedge execution
         response = getattr(req, "response_value", None)
-        self.response_cache[request_id] = (time.time(), response)
+        self.response_cache[request_id] = (time.time(), response, name or "")
         if len(self.response_cache) > self.response_cache_cap:
             # size bound (RESPONSE_CACHE_SIZE analog): evict the oldest
             # tenth so the cache (and its state-transfer ride-along)
-            # stays bounded under sustained load between checkpoint GCs
+            # stays bounded under sustained load between checkpoint GCs.
+            # Eviction is per-node (like the reference's time+size-GC'd
+            # GCConcurrentHashMap): exactly-once is guaranteed within the
+            # TTL/size window, not beyond it
             by_age = sorted(
                 self.response_cache.items(), key=lambda kv: kv[1][0]
             )
@@ -1361,6 +1458,8 @@ class PaxosManager:
         for g, (t0, _slot) in self._payload_blocked.items():
             if self._tick_no - t0 > self.PAYLOAD_BLOCKED_TICKS:
                 need[g] = True
+        for g in self._needs_state:
+            need[g] = True
         if not need.any():
             return
         versions = self._np("version")
@@ -1401,6 +1500,9 @@ class PaxosManager:
             g, name = int(ent["row"]), ent["name"]
             if self.names.get(name) != g:
                 continue
+            if g in self._needs_state:
+                continue  # blank-joined myself: serving my empty state
+                # would "heal" another blank member into blankness
             if int(self._np("version")[g]) != int(ent["version"]):
                 continue
             frontier = int(exec_np[g])
@@ -1427,9 +1529,13 @@ class PaxosManager:
             # re-proposed duplicate's first execution can predate payload
             # GC, leaving the one dedup entry that matters out of the
             # filter (caught by the chaos soak).
+            # entries for the SERVED names only, over their full in-TTL
+            # history (no dependence on payload retention)
+            served = {s_["paxos_id"] for s_ in states}
             cache = {
-                str(rid): [t, resp]
-                for rid, (t, resp) in self.response_cache.items()
+                str(rid): [t, resp, nm]
+                for rid, (t, resp, nm) in self.response_cache.items()
+                if nm in served
             }
             self.forward_out.append(
                 (body["from"], "state_reply",
@@ -1473,9 +1579,10 @@ class PaxosManager:
                 # the jump may then safely forget my in-window accepted
                 # values (all below the donor frontier, decided, obsolete)
                 jumps.append(ent)
-            elif (
-                donor_exec <= my_exec
-                and donor_exec > int(self.app_exec_slot[g])
+            elif donor_exec <= my_exec and (
+                donor_exec > int(self.app_exec_slot[g])
+                or (g in self._needs_state
+                    and donor_exec >= int(self.app_exec_slot[g]))
             ):
                 # device is current but the APP cursor stranded behind the
                 # payload-retention horizon: adopt the donor's app state at
@@ -1494,16 +1601,7 @@ class PaxosManager:
                 np.array([e["n_execd"] for e in jumps]),
                 np.array([e["stopped"] for e in jumps]),
             )
-        now = time.time()
-        for rid_s, ent in (response_cache or {}).items():
-            if isinstance(ent, (list, tuple)):
-                t, resp = float(ent[0]), ent[1]
-            else:  # legacy shape: bare response
-                t, resp = now, ent
-            # keep the DONOR's age: restamping as fresh would make this
-            # replica's eviction order diverge from its peers' far more
-            # than clock skew does (dedup sets must stay aligned)
-            self.response_cache.setdefault(int(rid_s), (min(t, now), resp))
+        self.install_dedup(response_cache)
         for ent in jumps:
             g = int(ent["row"])
             self.app.restore(ent["name"], ent["app_state"])
@@ -1511,6 +1609,7 @@ class PaxosManager:
             self._app_exec_dirty.add(g)
             self.pending_exec.pop(g, None)
             self._payload_blocked.pop(g, None)
+            self._needs_state.discard(g)
             if int(ent["stopped"]) and self.on_stop_executed is not None:
                 # the STOP decision will never execute locally (the jump
                 # landed past it) — fire the hook now so the epoch layer
@@ -1527,6 +1626,7 @@ class PaxosManager:
             self.app_exec_slot[g] = int(ent["exec"])
             self._app_exec_dirty.add(g)
             self._payload_blocked.pop(g, None)
+            self._needs_state.discard(g)
             pend = self.pending_exec.get(g)
             if pend:  # decisions at/past the adopted cursor still execute
                 for slot in [s for s in pend if s < int(ent["exec"])]:
@@ -1562,6 +1662,12 @@ class PaxosManager:
         self.logger.checkpoint(arrays, app_states, {
             "names": self.names,
             "pending_rows": sorted(self.pending_rows),
+            "needs_state": sorted(self._needs_state),
+            "response_cache": {
+                str(rid): [t, resp, nm]
+                for rid, (t, resp, nm) in self.response_cache.items()
+                if t >= time.time() - self.response_cache_ttl
+            },
             "paused": {
                 f"{n}@{e}": rec for (n, e), rec in (
                     self.paused.peek_items()
@@ -1582,7 +1688,8 @@ class PaxosManager:
         self._slots_since_ckpt = 0
         # response-cache GC piggybacks on checkpoint cadence
         cut = time.time() - self.response_cache_ttl
-        for key in [k for k, (t, _) in self.response_cache.items() if t < cut]:
+        for key in [k for k in self.response_cache
+                    if self.response_cache[k][0] < cut]:
             del self.response_cache[key]
 
     def drain_forward_out(self) -> List[Tuple[int, str, Dict]]:

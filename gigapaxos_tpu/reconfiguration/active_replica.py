@@ -72,6 +72,7 @@ class WaitEpochFinalState(ProtocolTask):
         if kind != "epoch_final_state":
             return ()
         self.done = True
+        self.ar.coordinator.install_dedup(body.get("dedup"))
         return self.ar._finish_start_epoch(self.body, body.get("state"))
 
 
@@ -109,7 +110,9 @@ class ActiveReplica:
         )
         # (name, epoch) -> final app state captured when the stop executed
         # (LargeCheckpointer / getEpochFinalCheckpointState analog)
-        self.final_states: Dict[Tuple[str, int], Optional[str]] = {}
+        # (name, epoch) -> {"state": app checkpoint, "dedup": stop-time
+        # exactly-once snapshot} captured when the epoch-final stop ran
+        self.final_states: Dict[Tuple[str, int], Dict] = {}
         # stop acks owed once the local stop executes: (name, epoch) -> [rc]
         self._pending_stop_acks: Dict[Tuple[str, int], List[Addr]] = {}
         # hook the coordinator's stop-execution signal (fires on execution
@@ -196,8 +199,9 @@ class ActiveReplica:
         fs_key = (name, int(body["prev_epoch"]))
         if fs_key in self.final_states:
             # I was in the previous epoch and hold the final state locally
+            # (my own dedup entries are already in my cache)
             self._ack_start(
-                body, self._create(body, self.final_states[fs_key])
+                body, self._create(body, self.final_states[fs_key]["state"])
             )
             return
         # fetch the previous epoch's final state from its actives; the task
@@ -325,8 +329,15 @@ class ActiveReplica:
         )
 
     def _on_stop_executed(self, name: str, row: int, epoch: int) -> None:
-        """Manager hook: fires on EVERY replica when the stop executes."""
-        self.final_states[(name, epoch)] = self.coordinator.app.checkpoint(name)
+        """Manager hook: fires on EVERY replica when the stop executes.
+        The dedup set is SNAPSHOTTED with the final state: entries this
+        node adds later (executing in the NEXT epoch) must not ride with
+        the previous epoch's state — they describe executions the fetched
+        state does not contain."""
+        self.final_states[(name, epoch)] = {
+            "state": self.coordinator.app.checkpoint(name),
+            "dedup": self.coordinator.dedup_for_name(name),
+        }
         for rc in self._pending_stop_acks.pop((name, epoch), []):
             self._ack_stop(rc, name, epoch)
 
@@ -339,7 +350,7 @@ class ActiveReplica:
     def _handle_request_final_state(self, body: Dict) -> None:
         name, epoch = body["name"], int(body["epoch"])
         key = (name, epoch)
-        state = self.final_states.get(key)
+        snap = self.final_states.get(key)
         if key not in self.final_states:
             # Restart fallback: the in-memory capture was lost, but if this
             # node still hosts (name, epoch) as its CURRENT mapping and the
@@ -352,12 +363,20 @@ class ActiveReplica:
                 or not self.coordinator.is_stopped(name)
             ):
                 return
-            state = self.coordinator.app.checkpoint(name)
-            self.final_states[key] = state
+            # safe here: this node hasn't moved past `epoch`, so its live
+            # dedup set has no next-epoch entries
+            snap = {
+                "state": self.coordinator.app.checkpoint(name),
+                "dedup": self.coordinator.dedup_for_name(name),
+            }
+            self.final_states[key] = snap
         self.send(("AR", int(body["from"])), "epoch_final_state", {
             "name": name,
             "epoch": epoch,  # the PREV epoch being served
-            "state": state,
+            "state": snap["state"],
+            # the STOP-TIME dedup snapshot travels with the state: the
+            # receiver's adopted history must carry exactly its own set
+            "dedup": snap["dedup"],
         })
 
     # ---- drop (handleDropEpochFinalState, :968) ------------------------

@@ -112,6 +112,13 @@ class AbstractReplicaCoordinator:
         """The engine row hosting (name, epoch) here, or None."""
         raise NotImplementedError
 
+    def dedup_for_name(self, name: str):
+        """Exactly-once entries to ship WITH an app-state handoff."""
+        raise NotImplementedError
+
+    def install_dedup(self, entries) -> None:
+        raise NotImplementedError
+
     def set_stop_callback(self, cb) -> None:
         """Register cb(name, row, epoch), fired when an epoch-final stop
         executes locally (on every replica)."""
@@ -201,6 +208,12 @@ class PaxosReplicaCoordinator(AbstractReplicaCoordinator):
 
     def epoch_row_of(self, name: str, epoch: int):
         return self.manager.epoch_row(name, epoch)
+
+    def dedup_for_name(self, name: str):
+        return self.manager.dedup_for_name(name)
+
+    def install_dedup(self, entries) -> None:
+        self.manager.install_dedup(entries)
 
     def set_stop_callback(self, cb) -> None:
         self.manager.on_stop_executed = cb

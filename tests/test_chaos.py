@@ -23,7 +23,15 @@ from gigapaxos_tpu.reconfiguration import RCState
 from gigapaxos_tpu.testing.rc_cluster import ReconfigurableCluster
 
 
-@pytest.mark.parametrize("seed", [1234, 7, 20260730])
+import os as _os
+
+_SEEDS = (
+    [int(_os.environ["CHAOS_SEED"])] if _os.environ.get("CHAOS_SEED")
+    else [1234, 7, 20260730]
+)
+
+
+@pytest.mark.parametrize("seed", _SEEDS)
 def test_chaos_soak(seed, monkeypatch):
     from gigapaxos_tpu.reconfiguration import active_replica as ar_mod
     from gigapaxos_tpu.reconfiguration import reconfigurator as rc_mod
