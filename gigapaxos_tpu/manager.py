@@ -69,6 +69,17 @@ def _mix32(h: int, vid: int) -> int:
         v32 = np.int32(vid)
         return int((h32 * np.int32(31) + v32) ^ (v32 << np.int32(7)))
 
+def _instance_tag(name: str, epoch: int) -> int:
+    """Deterministic nonzero int32 identity of (name, epoch) — the blob's
+    cross-instance guard (engine ``tag`` lane).  Every replica computes it
+    from the same create parameters, so tags agree without coordination;
+    0 is reserved for inert rows."""
+    import zlib
+
+    t = zlib.crc32(f"{name}:{int(epoch)}".encode("utf-8")) & 0x7FFFFFFF
+    return t or 1
+
+
 # vid layout: [node_id : 5][counter : 24] under STOP_BIT (bit 30) — the
 # counter wraps per node at ~16M in-flight request payloads, far above the
 # outstanding cap; node ids follow ballot.COORD_BITS (ids 0..31).
@@ -229,6 +240,8 @@ class PaxosManager:
 
         self._rid_nonce = _random.randrange(1 << 20, 1 << 37)
         self.queues: Dict[int, List[int]] = {}  # group row -> pending vids
+        # vid -> (name, epoch) it was proposed under (admission guard)
+        self.vid_scope: Dict[int, Tuple[str, int]] = {}
         self.forward_out: List[Tuple[int, str, Dict]] = []  # (dst, kind, body)
         self._fired_callbacks: List[Tuple[Callable, int, Optional[str]]] = []
         self.app_exec_slot = np.zeros(G, np.int64)  # host app cursor per group
@@ -302,6 +315,9 @@ class PaxosManager:
         )
         if rec.arrays is None:
             return
+        # checkpoints written before the tag lane existed lack the key —
+        # seed zeros here; the authoritative recompute below overwrites
+        rec.arrays.setdefault("tag", seed["tag"])
         self.state = EngineState(
             **{k: jnp.asarray(v) for k, v in rec.arrays.items()}
         )
@@ -488,6 +504,17 @@ class PaxosManager:
             self.state = EngineState(
                 **{k: jnp.asarray(v) for k, v in arrays.items()}
             )
+        # instance tags are derivable state — recompute from the restored
+        # name map rather than trusting the checkpoint (also upgrades
+        # checkpoints written before the tag lane existed, which restore
+        # as zeros and would freeze every group's consensus)
+        tags = np.asarray(self.state.tag).copy()
+        versions = self._np("version")
+        for nm, r in self.names.items():
+            tags[r] = _instance_tag(nm, int(versions[r]))
+        for (nm, e), r in self.old_epochs.items():
+            tags[r] = _instance_tag(nm, int(e))
+        self.state = self.state._replace(tag=jnp.asarray(tags))
         # synchronous rollforward through the app (initiateRecovery parity:
         # the reference fully replays before serving); slots whose payloads
         # are not local stay pending and heal via runtime peer pulls
@@ -603,6 +630,7 @@ class PaxosManager:
         self.state = create_groups(
             self.state, np.array([row]), np.array([mask]),
             np.array([coord0]), my_id=self.my_id, version=version,
+            tag=_instance_tag(name, version),
         )
         self.app_exec_slot[row] = 0
         self.queues.pop(row, None)
@@ -731,8 +759,15 @@ class PaxosManager:
             if held:
                 # unadmitted requests survive the pause in the record's
                 # shadow queue (journaled WITH the record — a crash while
-                # paused must not drop them); the resume re-queues them
+                # paused must not drop them); the resume re-queues them.
+                # Their admission scopes ride along: vid_scope is in-memory
+                # only, and a scope-less resumed vid would bypass the
+                # stale-vid admission guard after a crash
                 rec["held_vids"] = held
+                rec["held_scopes"] = {
+                    str(v): list(self.vid_scope[v])
+                    for v in held if v in self.vid_scope
+                }
             if self.logger:
                 self.logger.log_pause(rec)
             self.paused[(name, int(epoch))] = rec
@@ -851,6 +886,14 @@ class PaxosManager:
             held = rec.get("held_vids") or []
             if held:
                 self.queues[r] = [v for v in held if v in self.arena]
+                scopes = rec.get("held_scopes") or {}
+                for v in self.queues[r]:
+                    sc = scopes.get(str(v))
+                    # pre-scope records default to the resumed instance's
+                    # own scope (they were queued on its row)
+                    self.vid_scope[v] = (
+                        (str(sc[0]), int(sc[1])) if sc else (name, int(epoch))
+                    )
             self.row_activity[r] = time.time()
             return True
 
@@ -996,6 +1039,16 @@ class PaxosManager:
                     vid |= STOP_BIT
                 self.arena[vid] = request_value
                 self.vid_meta[vid] = (entry, request_id)
+                # admission scope: queued vids can outlive the instance
+                # they were proposed for (row re-homes carry held queues,
+                # preemption re-queues by row number) — the drain refuses
+                # to admit a vid into a different name's instance, or an
+                # epoch-final stop into any later epoch (chaos-soak find:
+                # a stale epoch-0 stop decided inside epoch 3 diverges any
+                # member whose dedup entry for it aged out)
+                self.vid_scope[vid] = (
+                    name, int(self._np("version")[row])
+                )
                 self.inflight[request_id] = vid
                 if callback is not None:
                     self.outstanding.put(request_id, callback)
@@ -1095,6 +1148,54 @@ class PaxosManager:
     def coordinator_of_row(self, row: int) -> int:
         return int(ballot_coord(int(self._np("bal")[row])))
 
+    def _filter_stale_vids(self, row: int, vids: List[int]) -> List[int]:
+        """Admission guard: drop queued vids whose proposal scope no
+        longer matches the instance now living at this row.  A vid may
+        ride a re-home, a pause record, or a preemption re-queue into a
+        row that has since been reused by another name, or into a later
+        epoch of the same name.  Ordinary requests legitimately cross
+        epochs (the app state carries over; exactly-once holds via the
+        dedup cache) — but an epoch-final STOP is epoch-specific: decided
+        in a later epoch it wrongly stops that epoch, and any member
+        whose dedup entry for it expired executes it (RSM divergence,
+        chaos-soak find).  Cross-NAME vids are always dropped.  Dropped
+        vids release their inflight slot so a retransmitted proposal
+        (e.g. the stop task's re-drive, which uses a deterministic
+        request id) is not deduped against the dead one."""
+        name = self.row_name.get(row)
+        epoch_now = int(self._np("version")[row])
+        keep: List[int] = []
+        for vid in vids:
+            if vid in self.retained:
+                # a preemption re-queue raced the decision: the original
+                # proposal got decided (and executed) after the re-queue,
+                # so this copy is done — drop it from the queue WITHOUT
+                # touching arena/meta (retention GC owns that lifecycle;
+                # peers may still pull the payload)
+                continue
+            scope = self.vid_scope.get(vid)
+            stale = scope is not None and (
+                scope[0] != name
+                or (bool(vid & STOP_BIT) and scope[1] != epoch_now)
+            )
+            if not stale and vid in self.arena:
+                keep.append(vid)
+                continue
+            # out-of-scope, or the payload is gone (decided elsewhere and
+            # retention-GC'd): nothing valid to propose — admitting it
+            # would decide a lost payload, and forwarding it would ship
+            # an EMPTY value that wedges the peer's RSM (chaos-soak find)
+            self.arena.pop(vid, None)
+            self.vid_scope.pop(vid, None)
+            _entry, rid = self.vid_meta.pop(vid, (None, None))
+            if rid is not None and self.inflight.get(rid) == vid:
+                del self.inflight[rid]
+        # ALWAYS install and return the live queue list: callers mutate the
+        # returned list in place (the forward branch clears it) and must be
+        # operating on the real queue, not a filtered copy
+        self.queues[row] = keep
+        return keep
+
     def build_requests(self) -> np.ndarray:
         """Drain queues into [G, K] lanes; forward non-coordinated groups'
         requests to their believed coordinator."""
@@ -1109,6 +1210,9 @@ class PaxosManager:
                 # nothing may commit on a row the reconfigurator's probe
                 # may still move; the queue drains once epoch_commit lands
                 continue
+            vids = self._filter_stale_vids(row, vids)
+            if not vids:
+                continue
             coord = int(ballot_coord(int(bal[row])))
             if coord != self.my_id:
                 name = self.row_name.get(row)
@@ -1117,10 +1221,13 @@ class PaxosManager:
                     continue
                 epoch_now = int(self._np("version")[row])
                 for vid in vids:
+                    value = self.arena.get(vid)
+                    if value is None:
+                        continue  # payload gone (decided + GC'd): drop
                     entry, rid = self.vid_meta.get(vid, (self.my_id, vid))
                     self.forward_out.append((coord, "forward", {
                         "name": name,
-                        "value": self.arena.get(vid, ""),
+                        "value": value,
                         "stop": bool(vid & STOP_BIT),
                         "request_id": rid,
                         "entry": entry,
@@ -1131,6 +1238,7 @@ class PaxosManager:
                     # self.outstanding keyed by request_id)
                     self.arena.pop(vid, None)
                     self.vid_meta.pop(vid, None)
+                    self.vid_scope.pop(vid, None)
                 vids.clear()
                 continue
             take = vids[:K]
@@ -1329,6 +1437,7 @@ class PaxosManager:
                     del self.retained[vid]
                     self.arena.pop(vid, None)
                     self.vid_meta.pop(vid, None)
+                    self.vid_scope.pop(vid, None)
 
     def _drain_pending_exec(self) -> List[int]:
         """Execute decided slots in order through the app, payload-gated;

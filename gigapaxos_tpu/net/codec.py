@@ -6,10 +6,13 @@ and hand-rolled byte layouts on one NIO channel,
 
 * ``J`` frames — JSON control messages: host-channel deltas, client
   requests/responses, failure-detection pings, admin ops.
-* ``B`` frames — packed engine blobs: sender id + tick + raw int32 leaf
+* ``C`` frames — packed engine blobs: sender id + tick + raw int32 leaf
   bytes in ``Blob._fields`` order (shapes are static per EngineConfig, so
   no per-leaf headers are needed — the reference's fixed-layout
-  ``RequestPacket.toBytes`` idea applied to whole state arrays).
+  ``RequestPacket.toBytes`` idea applied to whole state arrays).  The
+  kind byte doubles as the blob SCHEMA version (``B`` was the pre-tag
+  layout): a fixed-layout frame from a different schema must be dropped
+  by kind, never parsed misaligned.
 """
 
 from __future__ import annotations
@@ -42,14 +45,15 @@ def decode_json(payload: bytes) -> Tuple[str, int, Dict]:
 def blob_shapes(cfg: EngineConfig):
     G, W = cfg.n_groups, cfg.window
     return {
-        name: (G,) if name in ("bal", "exec_slot", "prep_bal", "prop_bal")
+        name: (G,)
+        if name in ("tag", "bal", "exec_slot", "prep_bal", "prop_bal")
         else (G, W)
         for name in Blob._fields
     }
 
 
 def encode_blob(sender: int, tick: int, blob: Blob) -> bytes:
-    parts = [_BHDR.pack(b"B", sender, tick)]
+    parts = [_BHDR.pack(b"C", sender, tick)]
     for leaf in blob:
         parts.append(np.asarray(leaf, np.int32).tobytes())
     return b"".join(parts)
@@ -57,8 +61,18 @@ def encode_blob(sender: int, tick: int, blob: Blob) -> bytes:
 
 def decode_blob(payload: bytes, cfg: EngineConfig) -> Tuple[int, int, Blob]:
     kind, sender, tick = _BHDR.unpack_from(payload, 0)
-    assert kind == b"B"
+    assert kind == b"C"
     shapes = blob_shapes(cfg)
+    expect = _BHDR.size + 4 * sum(int(np.prod(s)) for s in shapes.values())
+    if len(payload) != expect:
+        # fixed-layout frame: a size mismatch means the peer runs a
+        # different blob schema (version skew) or a different
+        # EngineConfig — misaligned leaves would feed garbage ballots
+        # into consensus, so reject the frame outright
+        raise ValueError(
+            f"blob frame size {len(payload)} != expected {expect} "
+            "(peer blob-schema/config mismatch)"
+        )
     off = _BHDR.size
     leaves = []
     for name in Blob._fields:

@@ -108,6 +108,13 @@ class EngineState(NamedTuple):
     majority: jnp.ndarray      # [G] popcount(member_mask)//2 + 1
     version: jnp.ndarray       # [G] epoch number (reconfiguration)
     stopped: jnp.ndarray       # [G] 1 after an epoch-final stop executed
+    tag: jnp.ndarray           # [G] instance identity (hash of name:epoch).
+    #   Rows are REUSED across instances (paxosID+version keying is by row
+    #   here, by string in the reference) — a stale holdout still running
+    #   the previous tenant of a row would otherwise merge its acceptor /
+    #   decision columns into the new tenant's consensus (a decided stop
+    #   of name A executing inside name B's RSM — chaos-soak find).  The
+    #   blob ships the tag and step() ignores peers whose tag differs.
     # --- acceptor (ref: PaxosAcceptor.java:82-103) ---
     bal: jnp.ndarray           # [G] promised ballot (packed)
     exec_slot: jnp.ndarray     # [G] first un-executed slot (frontier)
@@ -130,6 +137,7 @@ class EngineState(NamedTuple):
 class Blob(NamedTuple):
     """What one replica publishes per step (the all_gather payload)."""
 
+    tag: jnp.ndarray         # [G] sender's instance tag (cross-instance guard)
     bal: jnp.ndarray         # [G]
     exec_slot: jnp.ndarray   # [G]
     acc_bal: jnp.ndarray     # [G, W]
@@ -176,6 +184,7 @@ def init_state(cfg: EngineConfig) -> EngineState:
     gw = lambda fill: jnp.full((G, W), fill, jnp.int32)
     return EngineState(
         member_mask=g(0), majority=g(_BIG), version=g(0), stopped=g(0),
+        tag=g(0),
         bal=g(NULL), exec_slot=g(0),
         acc_bal=gw(NULL), acc_vid=gw(NULL), acc_slot=gw(NULL),
         dec_vid=gw(NULL), dec_slot=gw(NULL),
@@ -191,6 +200,7 @@ def make_blob(state: EngineState) -> Blob:
     active = state.c_phase == ACTIVE
     act2 = active[:, None]
     return Blob(
+        tag=state.tag,
         bal=state.bal,
         exec_slot=state.exec_slot,
         acc_bal=state.acc_bal,
@@ -240,7 +250,11 @@ def step(
     # heard and a member of the group (per-group replica subsets,
     # ``groupMembers[]`` analog, PaxosInstanceStateMachine.java:176-188).
     in_group = ((state.member_mask[None, :] >> rids[:, None]) & 1) == 1
-    live = heard[:, None] & in_group                      # [R, G]
+    # instance guard: a peer row speaking for a DIFFERENT tenant of this
+    # row index (stale holdout after row reuse, or a not-yet-caught-up
+    # joiner) is not part of this instance's consensus
+    same_inst = g.tag == state.tag[None, :]               # [R, G]
+    live = heard[:, None] & in_group & same_inst          # [R, G]
     live3 = live[:, :, None]                              # [R, G, 1]
 
     inert = state.member_mask == 0
@@ -495,7 +509,7 @@ def step(
 
     new_state = EngineState(
         member_mask=state.member_mask, majority=state.majority,
-        version=state.version, stopped=stopped,
+        version=state.version, stopped=stopped, tag=state.tag,
         bal=new_bal, exec_slot=exec_new,
         acc_bal=acc_bal, acc_vid=acc_vid, acc_slot=acc_slot,
         dec_vid=dec_vid, dec_slot=dec_slot,

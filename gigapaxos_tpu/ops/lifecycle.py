@@ -50,6 +50,7 @@ def create_groups(
     coord0: jnp.ndarray,       # [N] initial coordinator replica id
     my_id: int,
     version: jnp.ndarray | int = 0,
+    tag: jnp.ndarray | int = 0,
 ) -> EngineState:
     """Batched group creation.  All replicas run this identically, so the
     initial ballot (0, coord0) is implicitly promised everywhere — the
@@ -60,6 +61,7 @@ def create_groups(
     coord0 = jnp.asarray(coord0, jnp.int32)
     n = idx.shape[0]
     version = jnp.broadcast_to(jnp.asarray(version, jnp.int32), (n,))
+    tag = jnp.broadcast_to(jnp.asarray(tag, jnp.int32), (n,))
     bal0 = encode_ballot(jnp.zeros((n,), jnp.int32), coord0)
     i_am_coord = coord0 == my_id
     W = state.acc_bal.shape[1]
@@ -70,6 +72,7 @@ def create_groups(
         majority=state.majority.at[idx].set(_popcount32(member_mask) // 2 + 1),
         version=state.version.at[idx].set(version),
         stopped=state.stopped.at[idx].set(0),
+        tag=state.tag.at[idx].set(tag),
         bal=state.bal.at[idx].set(bal0),
         exec_slot=state.exec_slot.at[idx].set(0),
         acc_bal=state.acc_bal.at[idx].set(nullw),
@@ -99,6 +102,7 @@ def kill_groups(state: EngineState, idx: jnp.ndarray) -> EngineState:
         member_mask=state.member_mask.at[idx].set(0),
         majority=state.majority.at[idx].set(big),
         stopped=state.stopped.at[idx].set(0),
+        tag=state.tag.at[idx].set(0),
         bal=state.bal.at[idx].set(NULL),
         c_phase=state.c_phase.at[idx].set(IDLE),
         c_bal=state.c_bal.at[idx].set(NULL),

@@ -85,7 +85,7 @@ class PaxosServer:
     # ---- message ingress (demultiplexer analog) ------------------------
     def _on_message(self, payload: bytes, peer: Tuple[str, int], reply) -> None:
         kind = decode_kind(payload)
-        if kind == "B":
+        if kind == "C":
             sender, _tick, blob = decode_blob(payload, self.cfg)
             with self._blob_lock:
                 self._peer_blobs[sender] = blob

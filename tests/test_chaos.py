@@ -1,8 +1,9 @@
 """Seeded chaos soak over the reconfiguration plane: random creates,
-migrations, pauses, reactivating touches, deletes, and app traffic under
-random control-plane loss — then the system must settle to a consistent
-state (the reference's randomized TESTReconfiguration* suites compressed
-into one adversarial run).
+migrations, pauses, reactivating touches, deletes, elastic membership
+churn (remove/re-add actives), and app traffic under random
+control-plane loss — then the system must settle to a consistent state
+(the reference's randomized TESTReconfiguration* suites compressed into
+one adversarial run).
 
 End-state invariants:
   * every surviving record settles to READY/PAUSED (no wedged WAIT_*);
@@ -81,6 +82,16 @@ def test_chaos_soak(seed, monkeypatch):
                     )
             elif op < 0.85:  # touch (reactivates if paused)
                 c.client_request("request_actives", {"name": nm})
+            elif op < 0.92:  # elastic membership churn: remove, then re-add
+                removed = getattr(c, "_chaos_removed", None)
+                if removed is None:
+                    c.client_request("remove_active", {"id": rng.randrange(4)})
+                    c._chaos_removed = True
+                else:
+                    # re-add every node (idempotent) so capacity recovers
+                    for nid in range(4):
+                        c.client_request("add_active", {"id": nid})
+                    c._chaos_removed = None
             elif nm not in deleted and len(deleted) < 2:  # delete (max 2)
                 c.client_request("delete_service", {"name": nm})
                 deleted.add(nm)

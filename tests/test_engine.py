@@ -232,3 +232,37 @@ def test_per_group_membership_subset():
     assert fr[0, 0] == 1 and fr[1, 0] == 1
     assert fr[2, 0] == 0  # non-member untouched
     c.assert_rsm_invariant(groups=[1])
+
+
+def test_instance_tag_guard():
+    """Rows are reused across instances: a stale holdout still running the
+    row's PREVIOUS tenant must not contaminate the new tenant's consensus
+    (its decided values merging into the new instance executed a different
+    name's epoch-final stop inside a live group — chaos-soak find)."""
+    import jax.numpy as jnp
+
+    c = make_cluster(create_all=False)
+    c.create_group(0, members=[0, 1, 2])
+    # replica 2 is a stale holdout: same row, different instance tag, with
+    # a decided value sitting in its rings at the new tenant's frontier.
+    # Its own row is frozen (non-member in its local mask, like a holdout
+    # whose drop landed) but its blob still ships the poisoned rings.
+    st = c.states[2]
+    c.states[2] = st._replace(
+        tag=st.tag.at[0].set(999),
+        member_mask=st.member_mask.at[0].set(0b011),
+        dec_slot=st.dec_slot.at[0, 0].set(0),
+        dec_vid=st.dec_vid.at[0, 0].set(777),
+    )
+    c.run(5)
+    for r in (0, 1):
+        assert 777 not in np.asarray(c.states[r].dec_vid)[0], r
+        assert int(np.asarray(c.states[r].exec_slot)[0]) == 0
+    # matching tags (the committed instance) still decide normally
+    arr = no_reqs()
+    arr[0, 0] = 10
+    c.step_all(reqs={c.coordinator_of(0): arr})
+    c.run(5)
+    fr = c.exec_frontiers()
+    assert fr[0, 0] == 1 and fr[1, 0] == 1
+    assert c.checker.chosen[(0, 0)] == 10
