@@ -591,7 +591,7 @@ class PaxosManager:
                         f"{cur_row} is confirmed or already executed"
                     )
                 held_vids = list(self.queues.get(cur_row, []))
-                self._kill_locked(name)
+                self._kill_locked(name, release_queue=False)
             else:
                 # Epoch upgrade (reconfiguration): the stopped prior epoch's
                 # row stays resident under (name, old_epoch) until the
@@ -633,7 +633,7 @@ class PaxosManager:
             tag=_instance_tag(name, version),
         )
         self.app_exec_slot[row] = 0
-        self.queues.pop(row, None)
+        self._release_row_queue(row)  # stale leftovers of a prior tenant
         self.pending_exec.pop(row, None)
         self.row_activity[row] = time.time()
         if held_vids:
@@ -669,11 +669,28 @@ class PaxosManager:
         if self.logger:
             self.logger.log_unpend(np.array([row]))
 
+    def _release_row_queue(self, row: int) -> None:
+        """Drop a row's queue, releasing each vid's scheduling state so a
+        retransmitted request id RE-PROPOSES instead of being deduped
+        against the dead proposal forever (same discipline as
+        _filter_stale_vids); decided vids stay owned by retention GC."""
+        for vid in self.queues.pop(row, None) or []:
+            if vid in self.retained:
+                continue
+            self.arena.pop(vid, None)
+            self.vid_scope.pop(vid, None)
+            _entry, rid = self.vid_meta.pop(vid, (None, None))
+            if rid is not None and self.inflight.get(rid) == vid:
+                del self.inflight[rid]
+
     def kill(self, name: str) -> bool:
         with self._state_lock:
             return self._kill_locked(name)
 
-    def _kill_locked(self, name: str) -> bool:
+    def _kill_locked(self, name: str, release_queue: bool = True) -> bool:
+        # release_queue=False is for pause / re-home callers, which have
+        # snapshotted the queue for later re-queueing and need the vids'
+        # scheduling state (meta, inflight dedup, callbacks) to survive
         row = self.names.pop(name, None)
         if row is None:
             return False
@@ -684,7 +701,10 @@ class PaxosManager:
         self.state = kill_groups(self.state, np.array([row]))
         if self.logger:
             self.logger.log_kill(np.array([row]))
-        self.queues.pop(row, None)
+        if release_queue:
+            self._release_row_queue(row)
+        else:
+            self.queues.pop(row, None)
         self.pending_exec.pop(row, None)
         return True
 
@@ -721,7 +741,7 @@ class PaxosManager:
             self.state = kill_groups(self.state, np.array([row]))
             if self.logger:
                 self.logger.log_kill(np.array([row]))
-            self.queues.pop(row, None)
+            self._release_row_queue(row)
             self.pending_exec.pop(row, None)
             return True
 
@@ -771,7 +791,7 @@ class PaxosManager:
             if self.logger:
                 self.logger.log_pause(rec)
             self.paused[(name, int(epoch))] = rec
-            self._kill_locked(name)
+            self._kill_locked(name, release_queue=False)
             return "ok"
 
     def _extract_record(self, name: str, epoch: int, row: int) -> Dict:
@@ -1223,7 +1243,15 @@ class PaxosManager:
                 for vid in vids:
                     value = self.arena.get(vid)
                     if value is None:
-                        continue  # payload gone (decided + GC'd): drop
+                        # defensive (_filter_stale_vids drops these first):
+                        # release the scheduling state like the filter does
+                        # so a retransmit is not deduped against a dead vid
+                        self.vid_scope.pop(vid, None)
+                        _e, rid0 = self.vid_meta.pop(vid, (None, None))
+                        if rid0 is not None and \
+                                self.inflight.get(rid0) == vid:
+                            del self.inflight[rid0]
+                        continue
                     entry, rid = self.vid_meta.get(vid, (self.my_id, vid))
                     self.forward_out.append((coord, "forward", {
                         "name": name,
