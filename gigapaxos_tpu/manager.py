@@ -615,6 +615,12 @@ class PaxosManager:
                 )
         row = self.default_row_for(name) if row is None else int(row)
         if row in self.row_name:
+            # collision-NACK path: the name (if it was re-homed above) is
+            # already killed and cannot be re-queued here — release its
+            # held vids so client retransmits re-propose after the RC's
+            # next probe lands, instead of deduping against dead vids
+            for vid in held_vids:
+                self._release_vid(vid)
             raise RuntimeError(
                 f"row {row} already hosts {self.row_name[row]!r} (create for "
                 f"{name!r} must carry the creator's row)"
@@ -669,19 +675,23 @@ class PaxosManager:
         if self.logger:
             self.logger.log_unpend(np.array([row]))
 
+    def _release_vid(self, vid: int) -> None:
+        """Release one dead proposal's scheduling state so a retransmitted
+        request id RE-PROPOSES instead of being deduped against it forever
+        (the propose gate treats any vid still in vid_meta as live).
+        Decided vids stay owned by retention GC."""
+        if vid in self.retained:
+            return
+        self.arena.pop(vid, None)
+        self.vid_scope.pop(vid, None)
+        _entry, rid = self.vid_meta.pop(vid, (None, None))
+        if rid is not None and self.inflight.get(rid) == vid:
+            del self.inflight[rid]
+
     def _release_row_queue(self, row: int) -> None:
-        """Drop a row's queue, releasing each vid's scheduling state so a
-        retransmitted request id RE-PROPOSES instead of being deduped
-        against the dead proposal forever (same discipline as
-        _filter_stale_vids); decided vids stay owned by retention GC."""
+        """Drop a row's queue, releasing every queued vid."""
         for vid in self.queues.pop(row, None) or []:
-            if vid in self.retained:
-                continue
-            self.arena.pop(vid, None)
-            self.vid_scope.pop(vid, None)
-            _entry, rid = self.vid_meta.pop(vid, (None, None))
-            if rid is not None and self.inflight.get(rid) == vid:
-                del self.inflight[rid]
+            self._release_vid(vid)
 
     def kill(self, name: str) -> bool:
         with self._state_lock:
@@ -717,11 +727,16 @@ class PaxosManager:
             # with a journal tombstone (else the PAUSE block resurrects it
             # on recovery, and a later re-created incarnation of the name
             # could restore the dead incarnation's state)
-            if self.paused.pop((name, int(epoch)), None) is not None \
-                    and self.logger:
-                self.logger.log_pause({
-                    "name": name, "epoch": int(epoch), "dropped": True,
-                })
+            prec = self.paused.pop((name, int(epoch)), None)
+            if prec is not None:
+                # its shadow queue dies with it: release so retransmits of
+                # those request ids re-propose into the next incarnation
+                for vid in prec.get("held_vids") or []:
+                    self._release_vid(vid)
+                if self.logger:
+                    self.logger.log_pause({
+                        "name": name, "epoch": int(epoch), "dropped": True,
+                    })
             row = self.old_epochs.pop((name, epoch), None)
             if row is None:
                 # dropping the current epoch is only legal if it's stopped
@@ -1205,11 +1220,7 @@ class PaxosManager:
             # retention-GC'd): nothing valid to propose — admitting it
             # would decide a lost payload, and forwarding it would ship
             # an EMPTY value that wedges the peer's RSM (chaos-soak find)
-            self.arena.pop(vid, None)
-            self.vid_scope.pop(vid, None)
-            _entry, rid = self.vid_meta.pop(vid, (None, None))
-            if rid is not None and self.inflight.get(rid) == vid:
-                del self.inflight[rid]
+            self._release_vid(vid)
         # ALWAYS install and return the live queue list: callers mutate the
         # returned list in place (the forward branch clears it) and must be
         # operating on the real queue, not a filtered copy
@@ -1241,21 +1252,12 @@ class PaxosManager:
                     continue
                 epoch_now = int(self._np("version")[row])
                 for vid in vids:
-                    value = self.arena.get(vid)
-                    if value is None:
-                        # defensive (_filter_stale_vids drops these first):
-                        # release the scheduling state like the filter does
-                        # so a retransmit is not deduped against a dead vid
-                        self.vid_scope.pop(vid, None)
-                        _e, rid0 = self.vid_meta.pop(vid, (None, None))
-                        if rid0 is not None and \
-                                self.inflight.get(rid0) == vid:
-                            del self.inflight[rid0]
-                        continue
+                    # _filter_stale_vids (just above, same lock) guarantees
+                    # every kept vid has its payload in the arena
                     entry, rid = self.vid_meta.get(vid, (self.my_id, vid))
                     self.forward_out.append((coord, "forward", {
                         "name": name,
-                        "value": value,
+                        "value": self.arena[vid],
                         "stop": bool(vid & STOP_BIT),
                         "request_id": rid,
                         "entry": entry,

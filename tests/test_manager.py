@@ -190,3 +190,33 @@ def test_pending_gate_survives_restart(tmp_path):
     m2 = PaxosManager(0, NoopPaxosApp(), cfg, log_dir=d)
     assert m2.pending_rows == {2}
     m2.close()
+
+
+def test_retransmit_reproposes_after_row_killed():
+    """A queued-but-undecided request whose row is killed must not leave a
+    dead inflight entry behind: the client's retransmit (same request id)
+    has to RE-propose into the name's next incarnation and complete, not
+    be deduped against the dead proposal forever (review find on the
+    queue-drop sites)."""
+    c = ManagerCluster(CFG, StatefulAdderApp)
+    c.create("acct")
+    rid = 987654321
+    got = {}
+    # queue on a NON-coordinator entry but don't tick: the vid sits in the
+    # row's queue when the kill lands
+    m = c.managers[0]
+    row = m.names["acct"]
+    m.propose("acct", "5", request_id=rid,
+              callback=lambda r, resp: got.update({"first": resp}))
+    assert m.queues.get(row), "setup: vid must be queued"
+    for mm in c.managers:
+        mm.kill("acct")
+    assert rid not in m.inflight, "kill must release the inflight slot"
+    # the name is re-created (fresh incarnation) and the client retransmits
+    c.create("acct")
+    m.propose("acct", "7", request_id=rid,
+              callback=lambda r, resp: got.update({"second": resp}))
+    c.run(10)
+    assert got.get("second") == "7", got
+    assert all(mm.app.totals.get("acct", 0) == 7 for mm in c.managers)
+    c.close()
