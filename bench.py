@@ -49,15 +49,80 @@ def probe_tpu(timeout_s: float) -> tuple:
     return None, (r.stderr or "no output").strip()[-2000:]
 
 
+def probe_tpu_retrying(first_try_s: float, retry_s: float, tries: int,
+                       gap_s: float) -> tuple:
+    """A transient tunnel outage should not cost the round its TPU
+    number: spread several probe attempts across the bench invocation
+    before declaring fallback (VERDICT r3 #2).  The first attempt keeps
+    the long budget (a slow-but-working backend init must not be
+    misread as an outage); retries use a shorter one."""
+    err = ""
+    for i in range(max(1, tries)):
+        platform, err = probe_tpu(first_try_s if i == 0 else retry_s)
+        if platform is not None:
+            return platform, ""
+        print(
+            f"BENCH WARNING: TPU probe attempt {i + 1}/{tries} failed: {err}",
+            file=sys.stderr, flush=True,
+        )
+        if i + 1 < tries:
+            time.sleep(gap_s)
+    return None, err
+
+
+def record_tpu_evidence(result: dict, wall_s: float) -> None:
+    """Append a successful on-chip run to the committed evidence file so
+    the number survives even if a later driver bench hits an outage."""
+    import fcntl
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "TPU_EVIDENCE.json")
+    # serialize concurrent bench invocations (e.g. steady + failover modes
+    # in parallel): the read-modify-write below must not drop a run
+    with open(path + ".lock", "w") as lockf:
+        fcntl.flock(lockf, fcntl.LOCK_EX)
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            if not isinstance(doc, dict):
+                doc = {"what": "raw on-chip bench runs", "runs": []}
+        except (OSError, ValueError):
+            doc = {"what": "raw on-chip bench runs", "runs": []}
+        runs = doc.setdefault("runs", [])
+        if not isinstance(runs, list):
+            runs = doc["runs"] = []
+        runs.append({
+            "captured_utc": time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+            ),
+            "device_platform": "tpu",
+            "jax_platforms_env": os.environ.get("JAX_PLATFORMS", ""),
+            "wall_s": round(wall_s, 1),
+            "bench_json": result,
+        })
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+        os.replace(tmp, path)
+
+
 def main() -> None:
     # Decide the platform BEFORE any in-process backend init.  The env pins
     # JAX_PLATFORMS=axon via a site hook; if the chip can't init we must say
     # so loudly and fall back with a distinct marker — never silently.
+    t_start = time.perf_counter()
     env_platforms = os.environ.get("JAX_PLATFORMS", "")
     fallback = False
     if env_platforms and env_platforms != "cpu":
         probe_timeout = float(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "300"))
-        platform_probe, err = probe_tpu(probe_timeout)
+        probe_retry_timeout = float(
+            os.environ.get("BENCH_TPU_PROBE_RETRY_TIMEOUT", "120")
+        )
+        probe_tries = int(os.environ.get("BENCH_TPU_PROBE_TRIES", "3"))
+        platform_probe, err = probe_tpu_retrying(
+            probe_timeout, probe_retry_timeout, probe_tries, gap_s=15.0
+        )
         if platform_probe is None:
             print(
                 f"BENCH WARNING: TPU ({env_platforms}) unavailable: {err}\n"
@@ -164,13 +229,25 @@ def main() -> None:
 
     rate = total / dt
     mode = "failover-churn" if failover else "steady-state"
-    print(json.dumps({
+    result = {
         "metric": "committed_decisions_per_s",
         "value": round(rate, 1),
         "unit": f"decisions/s ({G} groups, 3 replicas, 1 chip, "
                 f"{mode}, {platform})",
         "vs_baseline": round(rate / NORTH_STAR, 3),
-    }))
+    }
+    # evidence entries are only meaningful for headline-shaped runs —
+    # a debug run with BENCH_G/W/K overridden must not pollute the file
+    headline_shape = not any(
+        v in os.environ for v in ("BENCH_G", "BENCH_W", "BENCH_K")
+    )
+    if platform == "tpu" and headline_shape:
+        try:
+            record_tpu_evidence(result, time.perf_counter() - t_start)
+        except Exception as e:
+            print(f"BENCH WARNING: could not record evidence: {e!r}",
+                  file=sys.stderr, flush=True)
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":
