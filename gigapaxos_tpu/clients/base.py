@@ -15,8 +15,9 @@ import asyncio
 import random
 import threading
 import time
-from typing import Callable, Dict, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
+from ..net.codec import encode_json
 from ..net.transport import MAGIC, _HDR
 from ..paxos_config import PC
 from ..utils.config import Config
@@ -41,11 +42,18 @@ class AsyncFrameClient:
         # flag snapshot (re-reading Config per message would contend on its
         # global lock inside the response hot path)
         self.callback_ttl = Config.get_float(PC.REQUEST_TIMEOUT_S)
-        # client ids live in [2^53, 2^62): disjoint from server-minted ids
-        # (namespaced vids < 2^31) and reconfiguration stop ids (bit 62 set);
-        # collision odds across clients negligible — the reference uses
-        # random 63-bit ids the same way (RequestPacket.java:83)
+        # client ids live in [2^53, 2^62): disjoint from reconfiguration
+        # stop ids (bit 62 set) and ABOVE the server-minted id range
+        # (nonce<<24 | counter < 2^61 — the two ranges overlap in
+        # [2^53, 2^61) and collisions are tolerated probabilistically,
+        # like the reference's random 63-bit ids, RequestPacket.java:83)
         self._next_id = random.randrange(1 << 53, 1 << 62)
+        # request aggregation: bodies buffered per address and flushed in
+        # one loop hop as a client_request_batch frame — under load the
+        # loop thread naturally lags a burst, so frames carry many
+        # requests (one json parse + one syscall each at the server)
+        self._agg: Dict[Addr, List[Dict]] = {}
+        self._agg_scheduled = False
 
     def mint_id(self) -> int:
         with self._lock:
@@ -55,6 +63,31 @@ class AsyncFrameClient:
     # ---- transport -----------------------------------------------------
     def send_frame(self, addr: Addr, frame: bytes) -> None:
         asyncio.run_coroutine_threadsafe(self._asend(addr, frame), self._loop)
+
+    def send_request_body(self, addr: Addr, body: Dict) -> None:
+        """Queue one app-request body for `addr`; bodies accumulated
+        before the loop thread runs the flush ride ONE
+        ``client_request_batch`` frame."""
+        with self._lock:
+            self._agg.setdefault(addr, []).append(body)
+            need_schedule = not self._agg_scheduled
+            self._agg_scheduled = True
+        if need_schedule:
+            self._loop.call_soon_threadsafe(self._flush_agg)
+
+    def _flush_agg(self) -> None:
+        with self._lock:
+            bufs, self._agg = self._agg, {}
+            self._agg_scheduled = False
+        tag = getattr(self, "my_tag", -1)
+        for addr, bodies in bufs.items():
+            if len(bodies) == 1:
+                frame = encode_json("client_request", tag, bodies[0])
+            else:
+                frame = encode_json(
+                    "client_request_batch", tag, {"reqs": bodies}
+                )
+            self._loop.create_task(self._asend(addr, frame))
 
     async def _asend(self, addr: Addr, frame: bytes) -> None:
         conn = self._conns.get(addr)
@@ -80,7 +113,20 @@ class AsyncFrameClient:
             writer.write(_HDR.pack(MAGIC, len(frame)) + frame)
             await writer.drain()
         except (ConnectionError, OSError):
-            self._conns.pop(addr, None)
+            self._evict_conn(addr, conn)
+
+    def _evict_conn(self, addr: Addr, conn) -> None:
+        """Drop a dead connection AND its read task — an orphaned read
+        task would linger until its reader errors, leaking one task per
+        reconnect under a flaky server.  Identity-guarded: a concurrent
+        reconnect may already have replaced the entry, and evicting the
+        replacement would destroy a healthy connection."""
+        if self._conns.get(addr) is not conn:
+            return
+        self._conns.pop(addr, None)
+        task = self._read_tasks.pop(addr, None)
+        if task is not None and task is not asyncio.current_task():
+            task.cancel()
 
     async def _read_loop(self, addr: Addr, reader: asyncio.StreamReader) -> None:
         try:
@@ -92,7 +138,14 @@ class AsyncFrameClient:
                 payload = await reader.readexactly(length)
                 self._dispatch(payload)
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
-            self._conns.pop(addr, None)
+            pass
+        finally:
+            # only clear entries still OWNED by this task: a reconnect may
+            # already have replaced them, and popping the replacement would
+            # orphan the live connection
+            if self._read_tasks.get(addr) is asyncio.current_task():
+                self._conns.pop(addr, None)
+                self._read_tasks.pop(addr, None)
 
     def _dispatch(self, payload: bytes) -> None:
         raise NotImplementedError

@@ -42,10 +42,10 @@ class PaxosClientAsync(AsyncFrameClient):
             if callback is not None:
                 self._callbacks[request_id] = (time.time(), callback)
         idx = random.randrange(len(self.servers)) if server is None else server
-        body = {"name": name, "value": value,
-                "request_id": request_id, "stop": stop}
-        frame = encode_json("client_request", self.my_tag, body)
-        self.send_frame(tuple(self.servers[idx]), frame)
+        self.send_request_body(tuple(self.servers[idx]), {
+            "name": name, "value": value,
+            "request_id": request_id, "stop": stop,
+        })
         return request_id
 
     def send_request_sync(
@@ -122,20 +122,10 @@ class PaxosClientAsync(AsyncFrameClient):
             return
         k, _s, body = decode_json(payload)
         if k == "client_response":
-            rid = int(body["request_id"])
-            if body.get("error") == "overload":
-                # transient shed, not an answer: keep the callback so the
-                # sync wrapper's retransmission gets the request through
-                return
-            with self._lock:
-                ent = self._callbacks.pop(rid, None)
-                # GC stale callbacks while we're here (REQUEST_TIMEOUT_S
-                # snapshot, the PaxosClientAsync 8s callback GC analog)
-                cut = time.time() - self.callback_ttl
-                for dead in [r for r, (t, _) in self._callbacks.items() if t < cut]:
-                    del self._callbacks[dead]
-            if ent:
-                ent[1](rid, body.get("response"))
+            self._on_response(body)
+        elif k == "client_response_batch":
+            for sub in body.get("resps", ()):
+                self._on_response(sub)
         elif k == "admin_response":
             key = f"admin:{body.get('op')}:{body.get('name')}"
             waiters = getattr(self, "_admin_waiters", {})
@@ -144,3 +134,19 @@ class PaxosClientAsync(AsyncFrameClient):
                 ev, box = ent
                 box["resp"] = body
                 ev.set()
+
+    def _on_response(self, body: Dict) -> None:
+        rid = int(body["request_id"])
+        if body.get("error") == "overload":
+            # transient shed, not an answer: keep the callback so the
+            # sync wrapper's retransmission gets the request through
+            return
+        with self._lock:
+            ent = self._callbacks.pop(rid, None)
+            # GC stale callbacks while we're here (REQUEST_TIMEOUT_S
+            # snapshot, the PaxosClientAsync 8s callback GC analog)
+            cut = time.time() - self.callback_ttl
+            for dead in [r for r, (t, _) in self._callbacks.items() if t < cut]:
+                del self._callbacks[dead]
+        if ent:
+            ent[1](rid, body.get("response"))

@@ -221,10 +221,10 @@ class ReconfigurableAppClient(AsyncFrameClient):
             # as a floor sample, or a server slower than the retransmit
             # interval would never accumulate any RTT evidence at all
             self.redirector.record(prev[2], time.time() - prev[0])
-        self.send_frame(addr, encode_json("client_request", self.my_tag, {
+        self.send_request_body(addr, {
             "name": name, "value": value,
             "request_id": request_id, "stop": stop,
-        }))
+        })
         return request_id
 
     def send_request_sync(
@@ -282,26 +282,10 @@ class ReconfigurableAppClient(AsyncFrameClient):
             return
         k, sender, body = decode_json(payload)
         if k == "client_response":
-            rid = int(body["request_id"])
-            now = time.time()
-            with self._lock:
-                ent = self._callbacks.get(rid)
-                if not body.get("error"):
-                    self._callbacks.pop(rid, None)
-                cut = now - self.callback_ttl
-                for dead in [r for r in self._callbacks
-                             if self._callbacks[r][0] < cut]:
-                    del self._callbacks[dead]
-            if ent:
-                # RTT attribution only when it is unambiguous: the reply
-                # came from the recorded target AND the request was sent
-                # exactly once — under retransmission the send time is the
-                # LATEST attempt's, so a slow server's late reply to the
-                # first attempt would record a falsely tiny RTT
-                if not body.get("error") and ent[2] is not None \
-                        and int(sender) == int(ent[2]) and ent[3] == 1:
-                    self.redirector.record(ent[2], now - ent[0])
-                ent[1](rid, body.get("response"), body.get("error"))
+            self._on_response(body, sender)
+        elif k == "client_response_batch":
+            for sub in body.get("resps", ()):
+                self._on_response(sub, sender)
         elif k == "rc_client_reply":
             kind = body.get("kind")
             b = body.get("body") or {}
@@ -310,3 +294,25 @@ class ReconfigurableAppClient(AsyncFrameClient):
             if ent:
                 ent[1]["body"] = b
                 ent[0].set()
+
+    def _on_response(self, body: Dict, sender: int) -> None:
+        rid = int(body["request_id"])
+        now = time.time()
+        with self._lock:
+            ent = self._callbacks.get(rid)
+            if not body.get("error"):
+                self._callbacks.pop(rid, None)
+            cut = now - self.callback_ttl
+            for dead in [r for r in self._callbacks
+                         if self._callbacks[r][0] < cut]:
+                del self._callbacks[dead]
+        if ent:
+            # RTT attribution only when it is unambiguous: the reply
+            # came from the recorded target AND the request was sent
+            # exactly once — under retransmission the send time is the
+            # LATEST attempt's, so a slow server's late reply to the
+            # first attempt would record a falsely tiny RTT
+            if not body.get("error") and ent[2] is not None \
+                    and int(sender) == int(ent[2]) and ent[3] == 1:
+                self.redirector.record(ent[2], now - ent[0])
+            ent[1](rid, body.get("response"), body.get("error"))

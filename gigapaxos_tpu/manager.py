@@ -80,11 +80,30 @@ def _instance_tag(name: str, epoch: int) -> int:
     return t or 1
 
 
-# vid layout: [node_id : 5][counter : 24] under STOP_BIT (bit 30) — the
-# counter wraps per node at ~16M in-flight request payloads, far above the
-# outstanding cap; node ids follow ballot.COORD_BITS (ids 0..31).
+# vid layout: [node_id : 5][counter : 24] under STOP_BIT (bit 30) and
+# BATCH_BIT (bit 29) — the counter wraps per node at ~16M in-flight
+# request payloads, far above the outstanding cap; node ids follow
+# ballot.COORD_BITS (ids 0..31).  A BATCH vid's arena payload is not an
+# app request but an encoded ORDERED LIST of client requests decided as
+# one consensus value (the true RequestBatcher semantics: up to
+# MAX_BATCH_SIZE requests per proposal, RequestPacket.java:189-246 nested
+# `batched` array + PaxosManager.proposeBatched:1226); execution unpacks
+# and runs each sub-request through the app with per-request dedup and
+# callbacks.  STOP_BIT and BATCH_BIT never combine: an epoch-final stop
+# is epoch-scoped and rides alone.
 VID_NODE_SHIFT = 24
 VID_COUNTER_MASK = (1 << VID_NODE_SHIFT) - 1
+BATCH_BIT = 1 << 29
+
+
+def encode_batch(subs: List[Tuple[int, int, str]]) -> str:
+    """Encode [(request_id, entry_replica, value), ...] as one arena
+    payload.  JSON keeps Python ints exact (client ids reach 2^62)."""
+    return json.dumps(subs, separators=(",", ":"))
+
+
+def decode_batch(payload: str) -> List[Tuple[int, int, str]]:
+    return [(int(r), int(e), v) for r, e, v in json.loads(payload)]
 
 
 class Outstanding:
@@ -164,6 +183,25 @@ class PaxosManager:
         # PaxosConfig.java:537): past this many in-flight requests the
         # entry path refuses with "overload" and clients back off
         self.max_outstanding = Config.get_int(PC.MAX_OUTSTANDING_REQUESTS)
+        # request coalescing (RequestBatcher analog, RequestBatcher.java:40):
+        # when a coordinated row's queue exceeds the lane count, consecutive
+        # plain requests are packed into ONE consensus value (a BATCH vid)
+        # of up to MAX_BATCH_SIZE sub-requests, so a hot group's throughput
+        # is bounded by lanes*batch per tick, not lanes per tick
+        self.batching_enabled = Config.get_bool(PC.BATCHING_ENABLED)
+        self.max_batch_size = max(1, Config.get_int(PC.MAX_BATCH_SIZE))
+        # minimum queued requests before coalescing bothers minting a batch
+        # (MIN_PP_BATCH_SIZE gate analog, PaxosConfig.java:852)
+        self.min_batch_trigger = max(2, Config.get_int(PC.MIN_PP_BATCH_SIZE))
+        # test/emulation modes (PaxosManager.java:1731-1778): UNREPLICATED
+        # answers at the entry replica without consensus (isolates app+wire
+        # cost); LAZY_PROPAGATION additionally still drives consensus but
+        # replies on local execution instead of commit
+        self.emulate_unreplicated = Config.get_bool(PC.EMULATE_UNREPLICATED)
+        self.lazy_propagation = Config.get_bool(PC.LAZY_PROPAGATION)
+        # request ids currently executing via an emulation mode (guards
+        # a retransmit racing the out-of-lock execution)
+        self._emulating: set = set()
 
         # host-side tables
         self.names: Dict[str, int] = {}        # service name -> CURRENT epoch row
@@ -207,9 +245,11 @@ class PaxosManager:
         self.arena: Dict[int, str] = {}        # vid -> request payload (json str)
         self.vid_meta: Dict[int, Tuple[int, int]] = {}  # vid -> (entry_replica, request_id)
         self.outstanding = Outstanding()
-        # request_id -> (time, response).  Ids are globally unique by
-        # construction: node-minted ids reuse the namespaced vid; client
-        # ids are random 53+ bit (PaxosClientAsync), disjoint ranges.
+        # request_id -> (time, response).  Ids are unique in practice,
+        # not by construction: node-minted ids ((nonce<<24)|counter, up
+        # to ~2^61) OVERLAP the client range [2^53, 2^62) — collisions
+        # are tolerated probabilistically, exactly like the reference's
+        # random 63-bit ids (RequestPacket.java:83).
         # Consulted at propose (fast dedup) AND at execution (a client
         # retransmitting to a different entry replica creates a second
         # proposal for the same logical request; every replica sees the
@@ -260,6 +300,12 @@ class PaxosManager:
         self._tick_no = 0
         self.total_executed = 0
         self._slots_since_ckpt = 0
+        self.last_engine_step_s = 0.0
+        # last tick where the engine made observable progress (admissions,
+        # accepts, commits, ballot movement) — the server's event-kicked
+        # cadence falls back to the timer when in-flight work stalls (a
+        # minority partition must not busy-spin the loop)
+        self.last_progress_tick = 0
         self._last_state_req: Dict[int, int] = {}  # row -> tick of last pull
         # rows whose app cursor is parked on a missing payload, and since
         # which tick: a payload GONE everywhere (GC'd before this member
@@ -385,7 +431,7 @@ class PaxosManager:
                 self._needs_state.add(r)
         self._next_counter = int(meta.get("next_counter", 1))
         for vid in rec.payloads:
-            base = vid & ~STOP_BIT
+            base = vid & ~(STOP_BIT | BATCH_BIT)
             if (base >> VID_NODE_SHIFT) == self.my_id:
                 self._next_counter = max(
                     self._next_counter, (base & VID_COUNTER_MASK) + 1
@@ -682,7 +728,16 @@ class PaxosManager:
         Decided vids stay owned by retention GC."""
         if vid in self.retained:
             return
-        self.arena.pop(vid, None)
+        payload = self.arena.pop(vid, None)
+        if (vid & BATCH_BIT) and payload is not None:
+            # release every member request's in-flight gate so their
+            # retransmits re-propose instead of waiting on a dead batch
+            try:
+                for rid, _entry, _value in decode_batch(payload):
+                    if self.inflight.get(rid) == vid:
+                        del self.inflight[rid]
+            except (ValueError, TypeError):
+                pass  # undecodable batch: the %64 inflight sweep heals
         self.vid_scope.pop(vid, None)
         _entry, rid = self.vid_meta.pop(vid, (None, None))
         if rid is not None and self.inflight.get(rid) == vid:
@@ -1037,6 +1092,7 @@ class PaxosManager:
         stall the tick loop or other transport threads)."""
         cached_hit = False
         cached_response = None
+        emulated = None
         with self._state_lock:
             row = self.names.get(name)
             if row is None:
@@ -1056,6 +1112,32 @@ class PaxosManager:
                 if callback is not None:
                     self.outstanding.put(request_id, callback)
                 return None
+            elif (
+                self.emulate_unreplicated or self.lazy_propagation
+            ) and not stop:
+                # EMULATE_UNREPLICATED / LAZY_PROPAGATION test modes
+                # (PaxosManager.java:1731-1778): execute at the entry
+                # replica IMMEDIATELY, without waiting for agreement, so a
+                # capacity run can attribute cost between app+wire and
+                # consensus.  UNREPLICATED skips consensus entirely;
+                # LAZY additionally still drives the proposal through the
+                # group (peers execute it; the entry's early execution is
+                # skipped at commit via the response cache) — both
+                # deliberately weaken RSM ordering and exist only for
+                # measurement.  The app call runs OUTSIDE the lock below
+                # (a slow/failing execute must not wedge the whole node);
+                # a concurrent retransmit while it runs is simply dropped
+                # (the client retries into the cache).
+                if request_id in self._emulating:
+                    return None
+                if self._next_counter > VID_COUNTER_MASK:
+                    raise RuntimeError("vid counter space exhausted")
+                counter = self._next_counter
+                self._next_counter += 1
+                if request_id is None:
+                    request_id = (self._rid_nonce << 24) | counter
+                self._emulating.add(request_id)
+                emulated = (counter, request_id)
             else:
                 if self._next_counter > VID_COUNTER_MASK:
                     raise RuntimeError("vid counter space exhausted")
@@ -1091,6 +1173,35 @@ class PaxosManager:
                 self.row_activity[row] = time.time()
                 self.demand_counts[name] = self.demand_counts.get(name, 0) + 1
                 self.demand_backlog += 1
+        if emulated is not None:
+            counter, request_id = emulated
+            from .packets.paxos_packets import RequestPacket
+
+            req = RequestPacket(
+                paxos_id=name, request_id=request_id,
+                request_value=request_value, stop=False,
+            )
+            self._app_execute_retrying(
+                req, do_not_reply=(entry != self.my_id)
+            )
+            response = getattr(req, "response_value", None)
+            with self._state_lock:
+                self._cache_response(request_id, response, name)
+                self.total_executed += 1
+                self.row_activity[row] = time.time()
+                self._emulating.discard(request_id)
+                if self.lazy_propagation and name in self.names:
+                    vid = (self.my_id << VID_NODE_SHIFT) | counter
+                    self.arena[vid] = request_value
+                    self.vid_meta[vid] = (entry, request_id)
+                    self.vid_scope[vid] = (
+                        name, int(self._np("version")[row])
+                    )
+                    self.inflight[request_id] = vid
+                    self.queues.setdefault(row, []).append(vid)
+            if callback:
+                callback(request_id, response)
+            return None
         if cached_hit:
             if callback:
                 callback(request_id, cached_response)
@@ -1103,6 +1214,39 @@ class PaxosManager:
     def overloaded(self) -> bool:
         """Entry back-pressure: too many in-flight requests here."""
         return len(self.inflight) >= self.max_outstanding
+
+    def has_backlog(self) -> bool:
+        """Unadmitted or undecided work exists (drives the server loop's
+        adaptive cadence).  Lock-free heuristic peek: a stale read only
+        costs one tick of the wrong cadence.  Queues held on PENDING rows
+        don't count — they cannot drain until the epoch commit lands, and
+        counting them would spin the loop through thousands of no-op
+        engine ticks for the whole pending window."""
+        if self.pending_exec:
+            return True
+        pending = self.pending_rows
+        return any(
+            vids and row not in pending
+            for row, vids in self.queues.items()
+        )
+
+    def engine_work_in_flight(self) -> bool:
+        """True while any member row holds consensus work that the next
+        peer blob can advance: accepted-but-unexecuted lanes or
+        outstanding coordinator proposals.  Drives the server's
+        event-kicked tick (a blob arriving mid-round should be consumed
+        NOW, not a full tick quantum later — per-hop quantum delays are
+        what made the socket path's round trip ~10x the engine's)."""
+        with self._state_lock:
+            acc_slot = self._np("acc_slot")
+            acc_vid = self._np("acc_vid")
+            exec_slot = self._np("exec_slot")
+            prop = self._np("c_prop_vid")
+        live = (
+            (acc_slot != NULL) & (acc_vid != NULL)
+            & (acc_slot >= exec_slot[:, None])
+        )
+        return bool(live.any() or (prop != NULL).any())
 
     # ------------------------------------------------------------------
     # host channel ingress (payload replication + forwarded proposals)
@@ -1227,6 +1371,59 @@ class PaxosManager:
         self.queues[row] = keep
         return keep
 
+    def _coalesce_row_queue(self, row: int, name: str, epoch: int,
+                            vids: List[int]) -> List[int]:
+        """Pack runs of plain requests into BATCH vids (the RequestBatcher
+        analog, ``RequestBatcher.java:40-158``): one consensus value then
+        decides up to MAX_BATCH_SIZE client requests.  FIFO order is
+        preserved; stops and already-minted batches pass through as their
+        own lanes.  Mutates scheduling tables: member vids' arena/meta/
+        scope move under the batch vid and their request ids repoint to it
+        so the in-flight propose dedup keeps gating retransmits."""
+        out: List[int] = []
+        chunk: List[int] = []
+
+        def flush() -> None:
+            if len(chunk) == 1:
+                out.append(chunk[0])
+            elif chunk:
+                subs = []
+                for v in chunk:
+                    entry, rid = self.vid_meta.get(v, (self.my_id, v))
+                    subs.append((rid, entry, self.arena[v]))
+                if self._next_counter > VID_COUNTER_MASK:
+                    raise RuntimeError("vid counter space exhausted")
+                bvid = (
+                    BATCH_BIT
+                    | (self.my_id << VID_NODE_SHIFT)
+                    | self._next_counter
+                )
+                self._next_counter += 1
+                self.arena[bvid] = encode_batch(subs)
+                # batch vids carry no single request id: -1 is outside
+                # every id namespace, so nothing ever dedups against it
+                self.vid_meta[bvid] = (self.my_id, -1)
+                self.vid_scope[bvid] = (name, epoch)
+                for v in chunk:
+                    self.arena.pop(v, None)
+                    _e, rid = self.vid_meta.pop(v, (None, None))
+                    self.vid_scope.pop(v, None)
+                    if rid is not None and self.inflight.get(rid) == v:
+                        self.inflight[rid] = bvid
+                out.append(bvid)
+            chunk.clear()
+
+        for v in vids:
+            if (v & (STOP_BIT | BATCH_BIT)) == 0:
+                chunk.append(v)
+                if len(chunk) >= self.max_batch_size:
+                    flush()
+            else:
+                flush()
+                out.append(v)
+        flush()
+        return out
+
     def build_requests(self) -> np.ndarray:
         """Drain queues into [G, K] lanes; forward non-coordinated groups'
         requests to their believed coordinator."""
@@ -1254,6 +1451,21 @@ class PaxosManager:
                 for vid in vids:
                     # _filter_stale_vids (just above, same lock) guarantees
                     # every kept vid has its payload in the arena
+                    if vid & BATCH_BIT:
+                        # a preemption re-queued this batch onto a row we
+                        # no longer coordinate: unbundle and forward the
+                        # members — the new coordinator re-coalesces them
+                        # under its own vid space
+                        for rid, entry, value in decode_batch(self.arena[vid]):
+                            self.forward_out.append((coord, "forward", {
+                                "name": name, "value": value, "stop": False,
+                                "request_id": rid, "entry": entry,
+                                "epoch": epoch_now,
+                            }))
+                        self.arena.pop(vid, None)
+                        self.vid_meta.pop(vid, None)
+                        self.vid_scope.pop(vid, None)
+                        continue
                     entry, rid = self.vid_meta.get(vid, (self.my_id, vid))
                     self.forward_out.append((coord, "forward", {
                         "name": name,
@@ -1271,6 +1483,14 @@ class PaxosManager:
                     self.vid_scope.pop(vid, None)
                 vids.clear()
                 continue
+            if self.batching_enabled and len(vids) > max(
+                K, self.min_batch_trigger - 1
+            ):
+                name = self.row_name.get(row)
+                if name is not None:
+                    vids = self.queues[row] = self._coalesce_row_queue(
+                        row, name, int(self._np("version")[row]), vids
+                    )
             take = vids[:K]
             req[row, : len(take)] = take
         return req
@@ -1326,6 +1546,11 @@ class PaxosManager:
 
         out_np = jax.tree.map(np.asarray, out)
         self._tick_no += 1
+        if (
+            out_np.n_admitted.any() or out_np.n_committed.any()
+            or out_np.acc_new.any() or out_np.bal_new.any()
+        ):
+            self.last_progress_tick = self._tick_no
         # re-propose preempted requests at a fresh slot (PREEMPTED analog)
         pre_g, pre_l = np.nonzero(out_np.preempted_vid != NULL)
         for g_, l_ in zip(pre_g, pre_l):
@@ -1503,6 +1728,82 @@ class PaxosManager:
                 del self.pending_exec[g]
         return missing
 
+    def _app_execute_retrying(self, req, do_not_reply: bool) -> None:
+        """Retry-forever execute (``PaxosInstanceStateMachine.java:
+        1647-1734``): a deterministic app must eventually execute a decided
+        request — giving up would silently skip a slot and diverge the
+        RSM, so the only alternatives are retry or wedge.  Backoff grows
+        1ms -> 100ms; sustained failure surfaces loudly (DelayProfiler
+        counter at /stats + a periodic stderr line) instead of raising
+        into the tick loop."""
+        delay = 0.001
+        attempt = 0
+        while True:
+            try:
+                if self.app.execute(req, do_not_reply_to_client=do_not_reply):
+                    return
+            except Exception:
+                pass
+            attempt += 1
+            DelayProfiler.update_count("app_execute_retries")
+            if attempt in (10, 100) or attempt % 1000 == 0:
+                import sys as _sys
+
+                print(
+                    f"gigapaxos: app refusing to execute "
+                    f"{req.paxos_id}#{req.request_id} ({attempt} attempts); "
+                    "retrying forever (node is wedged until it succeeds)",
+                    file=_sys.stderr, flush=True,
+                )
+            time.sleep(delay)
+            delay = min(delay * 2, 0.1)
+
+    def _execute_sub(self, name: Optional[str], request_id: int, entry: int,
+                     value: str) -> None:
+        """Execute ONE client request inside a decided batch, with the
+        same per-request dedup/caching/callback semantics as a singleton
+        decision (the reference's per-sub-request loop in execute(),
+        ``PaxosInstanceStateMachine.java:1647-1689``)."""
+        from .packets.paxos_packets import RequestPacket
+
+        if request_id in self.response_cache:
+            if entry == self.my_id:
+                cb = self.outstanding.pop(request_id)
+                if cb is not None:
+                    self._fired_callbacks.append(
+                        (cb, request_id, self.response_cache[request_id][1])
+                    )
+            return
+        req = RequestPacket(
+            paxos_id=name or "", request_id=request_id,
+            request_value=value, stop=False,
+        )
+        self._app_execute_retrying(req, do_not_reply=(entry != self.my_id))
+        self.total_executed += 1
+        self.inflight.pop(request_id, None)
+        response = getattr(req, "response_value", None)
+        self._cache_response(request_id, response, name or "")
+        if entry == self.my_id:
+            cb = self.outstanding.pop(request_id)
+            if cb is not None:
+                self._fired_callbacks.append((cb, request_id, response))
+
+    def _cache_response(self, request_id: int, response: Optional[str],
+                        name: str) -> None:
+        self.response_cache[request_id] = (time.time(), response, name)
+        if len(self.response_cache) > self.response_cache_cap:
+            # size bound (RESPONSE_CACHE_SIZE analog): evict the oldest
+            # tenth so the cache (and its state-transfer ride-along)
+            # stays bounded under sustained load between checkpoint GCs.
+            # Eviction is per-node (like the reference's time+size-GC'd
+            # GCConcurrentHashMap): exactly-once is guaranteed within the
+            # TTL/size window, not beyond it
+            by_age = sorted(
+                self.response_cache.items(), key=lambda kv: kv[1][0]
+            )
+            for rid, _ in by_age[: max(1, len(by_age) // 10)]:
+                del self.response_cache[rid]
+
     def _execute_one(self, name: Optional[str], g: int, slot: int, vid: int) -> bool:
         from .packets.paxos_packets import RequestPacket
 
@@ -1511,6 +1812,18 @@ class PaxosManager:
         payload = self.arena.get(vid)
         if payload is None:
             return False
+        if vid & BATCH_BIT:
+            # one decided slot carrying an ordered batch of client
+            # requests: unpack and run each through the app.  Every
+            # replica decodes the same payload in the same order, and the
+            # per-sub-request dedup decision is deterministic across the
+            # group (same decided sequence, same earlier executions), so
+            # the RSM stays convergent.
+            for request_id, entry, value in decode_batch(payload):
+                self._execute_sub(name, request_id, entry, value)
+            self._slots_since_ckpt += 1
+            self.retained[vid] = (g, slot)
+            return True
         entry, request_id = self.vid_meta.get(vid, (-1, vid))
         if request_id in self.response_cache:
             # duplicate of an already-executed request (client retransmit
@@ -1529,18 +1842,7 @@ class PaxosManager:
             paxos_id=name or "", request_id=request_id,
             request_value=payload, stop=bool(vid & STOP_BIT),
         )
-        # retry-forever semantics (execute(), :1647-1734): a deterministic
-        # app either executes or the whole node is wedged; we retry a few
-        # times then raise, since silently skipping breaks the RSM.
-        for _ in range(3):
-            try:
-                if self.app.execute(req, do_not_reply_to_client=(entry != self.my_id)):
-                    break
-            except Exception:
-                pass
-            time.sleep(0.001)
-        else:
-            raise RuntimeError(f"app refused to execute {name}:{slot}")
+        self._app_execute_retrying(req, do_not_reply=(entry != self.my_id))
         self.total_executed += 1
         self._slots_since_ckpt += 1
         self.inflight.pop(request_id, None)
@@ -1551,19 +1853,7 @@ class PaxosManager:
             except Exception:
                 pass  # reconfiguration-layer hook must not wedge execution
         response = getattr(req, "response_value", None)
-        self.response_cache[request_id] = (time.time(), response, name or "")
-        if len(self.response_cache) > self.response_cache_cap:
-            # size bound (RESPONSE_CACHE_SIZE analog): evict the oldest
-            # tenth so the cache (and its state-transfer ride-along)
-            # stays bounded under sustained load between checkpoint GCs.
-            # Eviction is per-node (like the reference's time+size-GC'd
-            # GCConcurrentHashMap): exactly-once is guaranteed within the
-            # TTL/size window, not beyond it
-            by_age = sorted(
-                self.response_cache.items(), key=lambda kv: kv[1][0]
-            )
-            for rid, _ in by_age[: max(1, len(by_age) // 10)]:
-                del self.response_cache[rid]
+        self._cache_response(request_id, response, name or "")
         if entry == self.my_id:
             cb = self.outstanding.pop(request_id)
             if cb is not None:

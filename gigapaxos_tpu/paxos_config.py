@@ -10,12 +10,10 @@ Register with :class:`gigapaxos_tpu.utils.Config` and read via
 
 from __future__ import annotations
 
-import enum
-
-from .utils.config import Config
+from .utils.config import Config, FlagEnum
 
 
-class PC(enum.Enum):
+class PC(FlagEnum):
     # ---- scale envelope (ref: PaxosConfig.java:263,532,537,403) -------
     PINSTANCES_CAPACITY = 2 ** 21        # max in-memory paxos groups (2M ref parity)
     MAX_GROUP_SIZE = 16                  # max replicas per group

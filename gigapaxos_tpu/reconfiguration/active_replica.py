@@ -273,14 +273,16 @@ class ActiveReplica:
         if cur is not None and cur > epoch:
             # historic round for a superseded epoch: nothing to confirm
             self.send(tuple(body["rc"]), "ack_epoch_commit", {
-                "name": name, "epoch": epoch, "from": self.my_id, "ok": True,
+                "name": name, "epoch": epoch, "from": self.my_id,
+                "ok": True, "row": row,
             })
             return
         hosted_row = self.coordinator.epoch_row_of(name, epoch)
         if cur == epoch and (row is None or hosted_row == int(row)):
             self.coordinator.commit_replica_group(name, epoch, row)
             self.send(tuple(body["rc"]), "ack_epoch_commit", {
-                "name": name, "epoch": epoch, "from": self.my_id, "ok": True,
+                "name": name, "epoch": epoch, "from": self.my_id,
+                "ok": True, "row": row,
             })
             return
         # not running the winning row of this epoch in any live form:
@@ -288,7 +290,7 @@ class ActiveReplica:
         # never started — all healed by the RC's committed resume
         self.send(tuple(body["rc"]), "ack_epoch_commit", {
             "name": name, "epoch": epoch, "from": self.my_id,
-            "ok": False, "reason": "missing",
+            "ok": False, "reason": "missing", "row": row,
         })
 
     # ---- stop (handleStopEpoch, ActiveReplica.java:917) ----------------

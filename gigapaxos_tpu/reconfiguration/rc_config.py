@@ -9,12 +9,10 @@ the TPU build's task re-drive machinery.  Register with
 
 from __future__ import annotations
 
-import enum
-
-from ..utils.config import Config
+from ..utils.config import Config, FlagEnum
 
 
-class RC(enum.Enum):
+class RC(FlagEnum):
     # ---- placement (ref: ReconfigurationConfig.java DEFAULT_NUM_REPLICAS)
     DEFAULT_NUM_REPLICAS = 3
 

@@ -255,7 +255,11 @@ class EpochCommitTask(ThresholdProtocolTask):
             rec = self.rcf.rc_app.get_record(self.name)
             if rec is None or rec.deleted or rec.epoch != self.epoch \
                     or rec.state is not RCState.READY \
+                    or rec.row != self.row \
                     or int(body["from"]) not in rec.actives:
+                # rec.row check (ADVICE r3): after a pause->reactivate the
+                # epoch survives but the row moves — this round's heal
+                # would resume the member back onto the OBSOLETE row
                 return None
             # RESUME semantics heal every missing shape uniformly: a
             # losing pending row re-homes with its held queue, a pause
@@ -492,8 +496,14 @@ class Reconfigurator:
                     f"redrop:{body['name']}:{body.get('epoch')}", kind, body
                 )
         elif kind in ("ack_epoch_commit",):
+            # row-keyed (ADVICE r3): a reactivation keeps the epoch but
+            # moves the row — its commit round must be independent of a
+            # stale round still live for the old row, or the correct-row
+            # round cannot spawn until the stale task expires
             self.tasks.handle_event(
-                f"commit:{body['name']}:{body.get('epoch')}", kind, body
+                f"commit:{body['name']}:{body.get('epoch')}"
+                f":{body.get('row')}",
+                kind, body,
             )
         elif kind in ("ack_pause_epoch",):
             self.tasks.handle_event(f"pause:{body['name']}", kind, body)
@@ -815,7 +825,7 @@ class Reconfigurator:
                         })
                         continue
                 if (name, rec.epoch, rec.row) not in self._commit_done:
-                    ckey = f"commit:{name}:{rec.epoch}"
+                    ckey = f"commit:{name}:{rec.epoch}:{rec.row}"
                     self.tasks.spawn_if_not_running(
                         ckey,
                         lambda k=ckey, n=name, r=rec: EpochCommitTask(
@@ -991,7 +1001,7 @@ class Reconfigurator:
                            "epoch": rec.epoch})
             self._last_attempt.pop(name, None)  # probe settled
             # lift the pre-COMPLETE admission gate on every new active
-            ckey = f"commit:{name}:{rec.epoch}"
+            ckey = f"commit:{name}:{rec.epoch}:{rec.row}"
             self.tasks.spawn_if_not_running(
                 ckey, lambda: EpochCommitTask(
                     ckey, self, name, rec.epoch, rec.actives, rec.row,

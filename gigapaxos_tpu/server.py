@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, Optional, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -62,11 +62,50 @@ class PaxosServer:
             Config.get_float(PC.TICK_INTERVAL_S)
             if tick_interval is None else tick_interval
         )
+        # adaptive cadence under load (the RequestBatcher adaptive-sleep
+        # analog, RequestBatcher.java:83 updateSleepDuration): the tick IS
+        # the batch aging window, so while a backlog exists the loop ticks
+        # as fast as the engine sustains, floored by BATCH_SLEEP_MS —
+        # shorter quantum = lower latency and smaller batches, exactly the
+        # trade the reference's sleep tuning makes
+        self._batching = Config.get_bool(PC.BATCHING_ENABLED)
+        self._batch_sleep_s = Config.get_float(PC.BATCH_SLEEP_MS) / 1000.0
         self._peer_blobs: Dict[int, Blob] = {}
         self._blob_lock = threading.Lock()
         self._tick = 0
         self._last_ping = 0.0
         self._stop = threading.Event()
+        # event-kicked cadence: a frame carrying NEW work (client request,
+        # forward, payloads, epoch-plane control) always wakes the loop;
+        # a peer BLOB wakes it only while consensus work is in flight —
+        # per-hop tick-quantum delays otherwise make the socket path's
+        # round trip ~10 unsynchronized quanta (~100ms) for a 3-tick
+        # protocol.  The reference needs none of this because it is fully
+        # event-driven per packet; the kick gives the tick loop the same
+        # arrival-driven latency while keeping the batched tick.
+        self._kick = threading.Event()
+        self._in_flight = False
+        # in-flight-without-progress bound: past this many stalled ticks
+        # blob arrivals stop kicking (a minority partition would otherwise
+        # busy-spin at engine speed until the partition heals)
+        self.STALL_TICKS = 512
+        # idle skip: with no new peer blob, no backlog, no in-flight work
+        # and no election pressure, the engine step is a pure no-op — skip
+        # it and run only host housekeeping.  Essential on small hosts: N
+        # idle node processes each burning an engine step per 10ms quantum
+        # starve the request path (this box has 1 core for 6 nodes).  A
+        # slow periodic full tick still runs so stragglers keep receiving
+        # blobs even from otherwise-idle peers.
+        self._blob_dirty = False
+        self._last_full_tick = 0.0
+        self._last_publish = 0.0
+        self.IDLE_REPUBLISH_S = 0.5
+        # per-connection client-response buffer: responses fired during a
+        # tick coalesce into ONE client_response_batch frame per
+        # connection (the PaxosPacketBatcher idea applied at the client
+        # boundary — on a small host, per-response frames dominate CPU)
+        self._resp_lock = threading.Lock()
+        self._resp_buf: Dict[int, Tuple[Callable, list]] = {}
         self._thread = threading.Thread(
             target=self._run, name=f"paxos-server-{my_id}", daemon=True
         )
@@ -78,6 +117,7 @@ class PaxosServer:
 
     def stop(self) -> None:
         self._stop.set()
+        self._kick.set()  # wake a sleeping tick loop so the join is quick
         self._thread.join(timeout=10)
         self.transport.stop()
         self.manager.close()
@@ -89,12 +129,26 @@ class PaxosServer:
             sender, _tick, blob = decode_blob(payload, self.cfg)
             with self._blob_lock:
                 self._peer_blobs[sender] = blob
+                self._blob_dirty = True
             self.fd.heard_from(sender)
+            m = self.manager
+            # with idle-skip below, peers only publish blobs when THEY
+            # have work — so a new blob is itself a new-work signal and
+            # wakes the loop, unless this node has been stalled in flight
+            # for a long time (wedged minority: fall back to the timer
+            # instead of busy-spinning at the peer's pace)
+            if m._tick_no - m.last_progress_tick < self.STALL_TICKS:
+                self._kick.set()
             return
         k, sender, body = decode_json(payload)
         if sender >= 0:
             self.fd.heard_from(sender)
         self._on_json(k, sender, body, reply)
+        if k != "fd_ping":
+            # every non-ping J frame is (or may carry) new work: requests,
+            # forwards, payload gossip, epoch-plane control.  Control
+            # traffic is low-rate, so the over-approximation is cheap.
+            self._kick.set()
 
     def _on_json(self, k: str, sender: int, body: Dict, reply) -> bool:
         """JSON-frame dispatch; subclasses extend (ReconfigurableNode roles
@@ -107,41 +161,71 @@ class PaxosServer:
             pass  # hearing it is the point (any traffic counts as alive)
         elif k == "client_request":
             self._on_client_request(body, reply)
+            self._flush_responses()
+        elif k == "client_request_batch":
+            # many requests in one frame (client-side coalescing; the
+            # nested `batched` RequestPacket array on the wire,
+            # RequestPacket.java:189-246)
+            for sub in body.get("reqs", ()):
+                self._on_client_request(sub, reply)
+            self._flush_responses()
         elif k == "admin":
             self._on_admin(body, reply)
         else:
             return False
         return True
 
+    def _buffer_response(self, reply, item: Dict) -> None:
+        with self._resp_lock:
+            ent = self._resp_buf.get(id(reply))
+            if ent is None:
+                self._resp_buf[id(reply)] = (reply, [item])
+            else:
+                ent[1].append(item)
+
+    def _flush_responses(self) -> None:
+        """Ship buffered client responses, one frame per connection."""
+        with self._resp_lock:
+            if not self._resp_buf:
+                return
+            bufs, self._resp_buf = self._resp_buf, {}
+        for reply, items in bufs.values():
+            if len(items) == 1:
+                reply(encode_json("client_response", self.my_id, items[0]))
+            else:
+                reply(encode_json(
+                    "client_response_batch", self.my_id, {"resps": items}
+                ))
+
     def _on_client_request(self, body: Dict, reply) -> None:
         request_id = int(body["request_id"])
+        name = body["name"]
         if self.manager.overloaded() and \
                 request_id not in self.manager.response_cache:
             # MAX_OUTSTANDING_REQUESTS back-pressure: shed at the entry
             # (clients back off and retry; retransmits of answered
             # requests still get their cached response below)
-            reply(encode_json("client_response", self.my_id, {
+            self._buffer_response(reply, {
                 "request_id": request_id, "response": None,
-                "name": body["name"], "error": "overload",
-            }))
+                "name": name, "error": "overload",
+            })
             return
 
         def cb(rid, response):
-            reply(encode_json("client_response", self.my_id, {
-                "request_id": rid, "response": response,
-                "name": body["name"],
-            }))
+            self._buffer_response(reply, {
+                "request_id": rid, "response": response, "name": name,
+            })
 
         vid = self.manager.propose(
-            body["name"], body.get("value", ""),
+            name, body.get("value", ""),
             callback=cb, stop=bool(body.get("stop", False)),
             request_id=request_id,
         )
         if vid is None and request_id not in self.manager.response_cache:
-            reply(encode_json("client_response", self.my_id, {
+            self._buffer_response(reply, {
                 "request_id": request_id, "response": None,
-                "name": body["name"], "error": "unknown_name",
-            }))
+                "name": name, "error": "unknown_name",
+            })
 
     def _on_admin(self, body: Dict, reply) -> None:
         op = body.get("op")
@@ -170,32 +254,76 @@ class PaxosServer:
         while not self._stop.is_set():
             t0 = time.perf_counter()
             try:
-                self.tick_once()
+                if self._should_tick():
+                    self.tick_once()
+                    self._last_full_tick = time.monotonic()
+                else:
+                    self.idle_once()
             except Exception:
                 import traceback
 
                 traceback.print_exc()
             dt = time.perf_counter() - t0
-            sleep = self.tick_interval - dt
+            interval = self.tick_interval
+            if self._batching and self.manager.has_backlog():
+                interval = max(
+                    self._batch_sleep_s, self.manager.last_engine_step_s
+                )
+            sleep = interval - dt
             if sleep > 0:
-                self._stop.wait(sleep)
+                self._kick.wait(sleep)
+            self._kick.clear()
+
+    def _should_tick(self) -> bool:
+        """A full engine tick is warranted only when something can change:
+        a fresh peer blob, local backlog/in-flight work, queued outbound
+        control traffic, election pressure, or the periodic republish."""
+        if self._blob_dirty or self._in_flight:
+            return True
+        m = self.manager
+        if m.has_backlog() or m.forward_out:
+            return True
+        if time.monotonic() - self._last_full_tick > self.IDLE_REPUBLISH_S:
+            return True
+        want = self.fd.want_coord(
+            m._np("bal"), m._np("member_mask"), self.cfg.n_replicas
+        )
+        return want is not None and bool(np.asarray(want).any())
+
+    def idle_once(self) -> None:
+        """Host housekeeping between engine ticks: FD pings, layered
+        protocol-task timers, callback GC.  Runs at the loop cadence so
+        liveness machinery never depends on consensus traffic."""
+        self._maybe_ping()
+        self.manager.outstanding.gc()
+        self._layer_tick()
+        self._flush_responses()
 
     def tick_once(self) -> None:
         R = self.cfg.n_replicas
-        my_blob = self.manager.blob()
+        # one device->host sync per leaf for my blob (reused below for the
+        # publish frame), then stack in NUMPY and upload once per leaf —
+        # per-peer jnp.asarray + jnp.stack costs 3x the device ops and
+        # dominated the tick at small G (it made the loopback round trip
+        # ~10x the engine time)
+        my_blob = jax.tree.map(np.asarray, self.manager.blob())
         with self._blob_lock:
             peer_blobs = dict(self._peer_blobs)
+            self._blob_dirty = False
         rows, heard = [], np.zeros(R, bool)
         for r in range(R):
             if r == self.my_id:
                 rows.append(my_blob)
                 heard[r] = True
             elif r in peer_blobs:
-                rows.append(jax.tree.map(jnp.asarray, peer_blobs[r]))
+                rows.append(peer_blobs[r])
                 heard[r] = True
             else:
                 rows.append(my_blob)
-        gathered = jax.tree.map(lambda *xs: jnp.stack(xs), *rows)
+        gathered = Blob(*(
+            jnp.asarray(np.stack([np.asarray(row[i]) for row in rows]))
+            for i in range(len(Blob._fields))
+        ))
         want = self.fd.want_coord(
             self.manager._np("bal"),
             self.manager._np("member_mask"),
@@ -203,12 +331,34 @@ class PaxosServer:
         )
         blob, delta = self.manager.tick(gathered, heard, want)
         self._tick += 1
+        m = self.manager
+        progressed = m.last_progress_tick == m._tick_no
+        # refreshed HERE (post-engine): gates blob-kick wakeups and the
+        # idle skip until the next tick updates it
+        self._in_flight = m.engine_work_in_flight()
 
-        # publish: blob to every peer (the all_gather stand-in)
-        blob_frame = encode_blob(self.my_id, self._tick, jax.tree.map(np.asarray, blob))
+        # publish: blob to every peer (the all_gather stand-in).  Gated:
+        # publishing from a tick that neither progressed nor has work in
+        # flight would re-trigger peers' blob-driven ticks and the
+        # cluster would ping-pong blobs forever at engine speed (idle
+        # must converge to silence; the periodic republish in
+        # _should_tick keeps stragglers healing).  In-flight republish
+        # doubles as the accept-retransmit poke (pokeLocalCoordinator
+        # analog, PaxosInstanceStateMachine.java:2140).
+        # the periodic fallback keys on time since the last PUBLISH, not
+        # the last tick: a node ticking continuously without progress
+        # (e.g. consuming a straggler's blobs) would otherwise never
+        # republish and the straggler could not heal from it
         peers = [r for r in self.node_config.get_node_ids() if r != self.my_id]
-        for r in peers:
-            self.transport.send_to_id(r, blob_frame)
+        if progressed or self._in_flight or (
+            time.monotonic() - self._last_publish > self.IDLE_REPUBLISH_S
+        ):
+            self._last_publish = time.monotonic()
+            blob_frame = encode_blob(
+                self.my_id, self._tick, jax.tree.map(np.asarray, blob)
+            )
+            for r in peers:
+                self.transport.send_to_id(r, blob_frame)
         if delta["arena"] or delta.get("app_exec"):
             frame = encode_json("payloads", self.my_id, delta)
             for r in peers:
@@ -224,8 +374,13 @@ class PaxosServer:
             else:
                 self.transport.send_to_id(dst, frame)
 
-        # failure-detection pings at period = timeout/2
-        # (FailureDetectionPacket wire schema, FailureDetectionPacket.java)
+        self._maybe_ping()
+        self._layer_tick()
+        self._flush_responses()  # callbacks fired by this tick's execution
+
+    def _maybe_ping(self) -> None:
+        """Failure-detection pings at period = timeout/2
+        (FailureDetectionPacket wire schema, FailureDetectionPacket.java)."""
         now = time.time()
         if now - self._last_ping > self.fd.ping_period_s:
             self._last_ping = now
@@ -234,10 +389,9 @@ class PaxosServer:
             ping = encode_json("fd_ping", self.my_id, FailureDetectionPacket(
                 sender=str(self.my_id), send_time=now,
             ).to_json())
-            for r in peers:
-                self.transport.send_to_id(r, ping)
-
-        self._layer_tick()
+            for r in self.node_config.get_node_ids():
+                if r != self.my_id:
+                    self.transport.send_to_id(r, ping)
 
     def _layer_tick(self) -> None:
         """Per-tick hook for layered roles (AR/RC protocol tasks)."""

@@ -23,6 +23,29 @@ _TRUE = frozenset(("true", "1", "yes", "on"))
 _FALSE = frozenset(("false", "0", "no", "off"))
 
 
+class FlagEnum(enum.Enum):
+    """Flag enum whose members carry their DEFAULT without enum aliasing.
+
+    A plain ``enum.Enum`` treats members with equal values as ALIASES of
+    one member — ``BATCHING_ENABLED = True`` and ``ENABLE_JOURNALING =
+    True`` would be the SAME flag, so overriding one silently overrode
+    every equal-valued sibling (this bit for real: setting
+    ``BATCHING_ENABLED=false`` turned journaling off).  Members here get
+    a unique ordinal ``value`` and keep the declared default in
+    ``.default``."""
+
+    def __new__(cls, default):
+        obj = object.__new__(cls)
+        obj._value_ = len(cls.__members__)  # unique ordinal: never aliases
+        obj.default = default
+        return obj
+
+
+def flag_default(member: Any) -> Any:
+    """The declared default of a flag member (FlagEnum or plain enum)."""
+    return getattr(member, "default", member.value)
+
+
 def _coerce(raw: str, default: Any) -> Any:
     """Coerce a string property to the type of the enum default."""
     if isinstance(default, bool):
@@ -70,10 +93,11 @@ class Config:
         with cls._lock:
             cls._registered[flag_enum.__name__] = flag_enum
             for member in flag_enum:
-                cls._defaults[f"{flag_enum.__name__}.{member.name}"] = member.value
+                default = flag_default(member)
+                cls._defaults[f"{flag_enum.__name__}.{member.name}"] = default
                 # Bare name resolves too; a later-registered enum shadows an
                 # earlier one (qualified "Enum.MEMBER" names never collide).
-                cls._defaults[member.name] = member.value
+                cls._defaults[member.name] = default
 
     @classmethod
     def load_file(cls, path: str) -> None:
@@ -117,7 +141,7 @@ class Config:
         """Return (raw_override_or_None, default)."""
         if isinstance(key, enum.Enum):
             names = (f"{type(key).__name__}.{key.name}", key.name)
-            default = key.value
+            default = flag_default(key)
         else:
             names = (str(key),)
             default = cls._defaults.get(str(key))

@@ -35,6 +35,8 @@ def main() -> int:
     ap.add_argument("--window-s", type=float, default=3.0,
                     help="measurement window per load step")
     ap.add_argument("--groups", type=int, default=10)
+    ap.add_argument("--clients", type=int, default=4,
+                    help="injector threads (NUM_CLIENTS analog)")
     ap.add_argument("--max-rounds", type=int, default=12)
     ap.add_argument("--cpu", action="store_true",
                     help="pin the JAX backend to CPU")
@@ -158,11 +160,15 @@ def main() -> int:
     for nm in names:
         client.send_request_sync(nm, "warm", timeout=30)
 
+    n_injectors = args.clients
+
     def run_round(rate: float):
-        """Fire at `rate` for window_s; return (resp_rate, mean_lat_s)."""
-        sent = 0
+        """Fire at `rate` for window_s from N injector threads (the
+        reference drives its probe with NUM_CLIENTS=9 senders,
+        ``TESTPaxosConfig.java:115``); return (resp_rate, mean_lat_s)."""
         lock = threading.Lock()
         done = []  # latencies
+        sent_counts = [0] * n_injectors
 
         def cb_factory(t0):
             def cb(rid, resp, error):
@@ -171,22 +177,33 @@ def main() -> int:
                         done.append(time.time() - t0)
             return cb
 
-        interval = 1.0 / rate
-        t_end = time.time() + args.window_s
-        next_t = time.time()
-        i = 0
-        while time.time() < t_end:
-            now = time.time()
-            if now < next_t:
-                time.sleep(min(interval, next_t - now))
-                continue
-            next_t += interval
-            nm = names[i % len(names)]
-            i += 1
-            client.send_request(nm, f"p{i}", cb_factory(time.time()))
-            sent += 1
+        def inject(idx: int):
+            interval = n_injectors / rate
+            t_end = time.time() + args.window_s
+            next_t = time.time() + interval * idx / n_injectors
+            i = 0
+            while time.time() < t_end:
+                now = time.time()
+                if now < next_t:
+                    time.sleep(min(interval, next_t - now))
+                    continue
+                next_t += interval
+                nm = names[(i * n_injectors + idx) % len(names)]
+                i += 1
+                client.send_request(nm, f"p{idx}x{i}", cb_factory(time.time()))
+                sent_counts[idx] += 1
+
+        threads = [
+            threading.Thread(target=inject, args=(j,), daemon=True)
+            for j in range(n_injectors)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
         # grace: late responses within the latency budget still count
         time.sleep(min(1.0, args.latency_ms / 1000.0))
+        sent = sum(sent_counts)
         with lock:
             n_ok = len(done)
             lat = sum(done) / n_ok if n_ok else float("inf")

@@ -1,0 +1,59 @@
+"""EMULATE_UNREPLICATED / LAZY_PROPAGATION test modes
+(``PaxosManager.java:1731-1778``): bypass or decouple consensus so a
+capacity run can attribute cost between app+wire and agreement."""
+
+from gigapaxos_tpu.models.apps import HashChainApp
+from gigapaxos_tpu.ops.engine import EngineConfig
+from gigapaxos_tpu.testing.cluster import ManagerCluster
+from gigapaxos_tpu.utils.config import Config
+
+
+def cfg():
+    return EngineConfig(n_groups=8, window=8, req_lanes=4, n_replicas=3)
+
+
+def test_emulate_unreplicated_answers_without_consensus():
+    Config.set("EMULATE_UNREPLICATED", "true")
+    try:
+        c = ManagerCluster(cfg(), HashChainApp)
+        c.create("u", members=[0, 1, 2])
+        done = {}
+        for i in range(10):
+            c.submit("u", f"v{i}", entry=0,
+                     callback=lambda rid, r: done.setdefault(rid, r))
+        # NO cluster ticks ran: responses must already be there
+        assert len(done) == 10
+        assert all(r is not None for r in done.values())
+        assert c.managers[0].app.n_executed.get("u") == 10
+        # peers never executed anything (consensus fully bypassed)
+        assert c.managers[1].app.n_executed.get("u") is None
+        # a retransmitted id answers from the cache without re-execution
+        rid = next(iter(done))
+        got = []
+        c.managers[0].propose("u", "dup", request_id=rid,
+                              callback=lambda r, resp: got.append(resp))
+        assert got == [done[rid]]
+        assert c.managers[0].app.n_executed.get("u") == 10
+        c.close()
+    finally:
+        Config.clear()
+
+
+def test_lazy_propagation_replies_early_but_still_replicates():
+    Config.set("LAZY_PROPAGATION", "true")
+    try:
+        c = ManagerCluster(cfg(), HashChainApp)
+        c.create("l", members=[0, 1, 2])
+        done = {}
+        for i in range(8):
+            c.submit("l", f"v{i}", entry=0,
+                     callback=lambda rid, r: done.setdefault(rid, r))
+        assert len(done) == 8  # answered before any tick
+        c.run(15)  # ...but the proposals still flow through the group
+        counts = [m.app.n_executed.get("l") for m in c.managers]
+        # peers executed every request through consensus; the entry's
+        # early executions were deduped at commit time
+        assert counts[0] == 8 and counts[1] == 8 and counts[2] == 8, counts
+        c.close()
+    finally:
+        Config.clear()

@@ -172,7 +172,8 @@ def test_overload_backpressure():
             assert mgr.overloaded(), "cap never reached under flood"
             # shed path answers 'overload' while saturated
             raw_reply = []
-            servers[0]._on_client_request(
+            servers[0]._on_json(
+                "client_request", -1,
                 {"request_id": 999999999, "name": "bp", "value": "x"},
                 lambda frame: raw_reply.append(frame),
             )

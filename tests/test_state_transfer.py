@@ -34,6 +34,18 @@ def _run_until_executed(c, name, vals, entry, delivery=None, max_steps=60):
 
 
 def test_dead_replica_rejoins_via_checkpoint_jump(tmp_path):
+    # batching off: this test drives the frontier far past the ring by
+    # slot COUNT, and coalescing would pack each burst into ~2 slots
+    from gigapaxos_tpu.utils.config import Config
+
+    Config.set("BATCHING_ENABLED", "false")
+    try:
+        _jump_body(tmp_path)
+    finally:
+        Config.clear()
+
+
+def _jump_body(tmp_path):
     cfg = EngineConfig(n_groups=8, window=8, req_lanes=4, n_replicas=3)
     dirs = [os.path.join(str(tmp_path), f"n{i}") for i in range(3)]
     c = ManagerCluster(cfg, HashChainApp, log_dirs=dirs)

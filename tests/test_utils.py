@@ -88,6 +88,35 @@ def test_flags_reach_the_framework(tmp_path):
         Config.clear()
 
 
+def test_no_flag_aliasing():
+    """Plain enum.Enum treats equal-valued members as ALIASES of one
+    member — so overriding BATCHING_ENABLED used to flip
+    ENABLE_JOURNALING too (both default True): a capacity run with
+    batching disabled silently lost its journal.  Every registered flag
+    must be a distinct member with independent override behavior."""
+    from gigapaxos_tpu.paxos_config import PC
+    from gigapaxos_tpu.reconfiguration.rc_config import RC
+    from gigapaxos_tpu.utils.config import Config, flag_default
+
+    for enum_cls in (PC, RC):
+        members = {name: m for name, m in enum_cls.__members__.items()}
+        assert len(set(members.values())) == len(members), (
+            "aliased flags in " + enum_cls.__name__
+        )
+    Config.clear()
+    try:
+        Config.set("BATCHING_ENABLED", "false")
+        assert Config.get_bool(PC.ENABLE_JOURNALING) is True
+        assert Config.get_bool(PC.PAUSE_OPTION) is True
+        assert Config.get_bool(PC.BATCHING_ENABLED) is False
+        Config.set("ENGINE_ROWS", "128")
+        assert Config.get_int(PC.RESPONSE_CACHE_SIZE) == flag_default(
+            PC.RESPONSE_CACHE_SIZE
+        )
+    finally:
+        Config.clear()
+
+
 def test_diskmap_spills_and_restores(tmp_path):
     """DiskMap analog (DiskMap.java:97): cold entries page to disk and
     restore transparently; deletes reach spilled entries."""
