@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import asyncio
 import random
+import ssl
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
@@ -29,7 +30,16 @@ class AsyncFrameClient:
     """Loop thread + per-address connections; subclasses override
     :meth:`_dispatch` for inbound frames."""
 
-    def __init__(self) -> None:
+    def __init__(self, ssl_context=None) -> None:
+        # TLS dialer context (client_ssl_context() under SERVER_AUTH /
+        # MUTUAL_AUTH; None = cleartext).  Defaults from the flag system
+        # so `from_properties`-style constructions pick the cluster mode
+        # up automatically.
+        if ssl_context is None:
+            from ..net.ssl_util import client_ssl_context
+
+            ssl_context = client_ssl_context()
+        self._ssl_ctx = ssl_context
         self._loop = asyncio.new_event_loop()
         self._thread = threading.Thread(
             target=self._loop.run_forever,
@@ -54,11 +64,27 @@ class AsyncFrameClient:
         # requests (one json parse + one syscall each at the server)
         self._agg: Dict[Addr, List[Dict]] = {}
         self._agg_scheduled = False
+        self._last_cb_gc = 0.0  # periodic callback-TTL sweep clock
 
     def mint_id(self) -> int:
         with self._lock:
             self._next_id += 1
             return self._next_id
+
+    def _gc_callbacks_locked(self, now: float) -> None:
+        """PERIODIC TTL sweep of ``self._callbacks`` (subclass-owned dict
+        whose entries lead with the registration time).  Call under
+        ``self._lock``.  Periodic, not per-response: sweeping on every
+        response is O(outstanding) per response — quadratic under load,
+        and it was the single largest client cost in the capacity probe
+        before being throttled."""
+        if now - self._last_cb_gc <= 1.0:
+            return
+        self._last_cb_gc = now
+        cut = now - self.callback_ttl
+        callbacks = self._callbacks
+        for dead in [r for r, ent in callbacks.items() if ent[0] < cut]:
+            del callbacks[dead]
 
     # ---- transport -----------------------------------------------------
     def send_frame(self, addr: Addr, frame: bytes) -> None:
@@ -93,8 +119,10 @@ class AsyncFrameClient:
         conn = self._conns.get(addr)
         if conn is None:
             try:
-                reader, writer = await asyncio.open_connection(addr[0], addr[1])
-            except OSError:
+                reader, writer = await asyncio.open_connection(
+                    addr[0], addr[1], ssl=self._ssl_ctx
+                )
+            except (OSError, ssl.SSLError):
                 return
             raced = self._conns.get(addr)
             if raced is not None:

@@ -141,12 +141,10 @@ class PaxosClientAsync(AsyncFrameClient):
             # transient shed, not an answer: keep the callback so the
             # sync wrapper's retransmission gets the request through
             return
+        now = time.time()
         with self._lock:
             ent = self._callbacks.pop(rid, None)
-            # GC stale callbacks while we're here (REQUEST_TIMEOUT_S
-            # snapshot, the PaxosClientAsync 8s callback GC analog)
-            cut = time.time() - self.callback_ttl
-            for dead in [r for r, (t, _) in self._callbacks.items() if t < cut]:
-                del self._callbacks[dead]
+            # REQUEST_TIMEOUT_S sweep (the PaxosClientAsync 8s GC analog)
+            self._gc_callbacks_locked(now)
         if ent:
             ent[1](rid, body.get("response"))

@@ -54,12 +54,22 @@ class ReconfigurableAppClient(AsyncFrameClient):
     @classmethod
     def from_properties(cls) -> "ReconfigurableAppClient":
         """Build the address books from ``active.*``/``reconfigurator.*``
-        config entries (ids by sorted name, matching NodeConfig)."""
+        config entries (ids by sorted name, matching NodeConfig).  With
+        the CLIENT_SSL_MODE port split configured, client traffic targets
+        each node's client-facing listener at port + CLIENT_PORT_OFFSET."""
+        from ..net.ssl_util import client_plane_split
+        from ..paxos_config import PC
+
+        off = (
+            Config.get_int(PC.CLIENT_PORT_OFFSET)
+            if client_plane_split() else 0
+        )
         ar = Config.node_addresses("active")
         rc = Config.node_addresses("reconfigurator")
         return cls(
-            {i: ar[n] for i, n in enumerate(sorted(ar))},
-            [rc[n] for n in sorted(rc)],
+            {i: (ar[n][0], ar[n][1] + off)
+             for i, n in enumerate(sorted(ar))},
+            [(rc[n][0], rc[n][1] + off) for n in sorted(rc)],
         )
 
     # ------------------------------------------------------------------
@@ -120,6 +130,137 @@ class ReconfigurableAppClient(AsyncFrameClient):
                 return {"name": name, "ok": True, "actives": acts,
                         "existed": True}
         return ack
+
+    def create_names(
+        self,
+        names,
+        timeout: float = 30.0,
+        retransmit_every: float = 2.0,
+    ) -> Dict[str, Dict]:
+        """Batched create (``sendRequest`` batched-CreateServiceName
+        parity, ``Reconfigurator.java:484-680``): N names are split by
+        RC-ring ownership and each owning RC gets ONE
+        ``create_service_batch`` round trip — mass-creating names costs a
+        few RTs per RC group, not one per name.  `names` is a list of
+        names or (name, initial_state) pairs.  Returns {name: result};
+        names the RC reports ``forwarded`` (client/server ring drift) are
+        retried individually."""
+        from ..reconfiguration.chash import ConsistentHashing
+
+        ring = ConsistentHashing(list(range(len(self.reconfigurators))))
+        by_rc: Dict[int, List[Dict]] = {}
+        for item in names:
+            name, init = item if isinstance(item, tuple) else (item, None)
+            rc = ring.get_replicated_servers(name, 1)[0]
+            by_rc.setdefault(rc, []).append(
+                {"name": name, "initial_state": init}
+            )
+        results: Dict[str, Dict] = {}
+        for rc, creates in by_rc.items():
+            batch_id = f"b{self.mint_id()}"
+            got = self._batch_create_sync(
+                rc, batch_id, creates, timeout, retransmit_every
+            )
+            results.update(got or {})
+        for nm, res in list(results.items()):
+            if res.get("reason") == "forwarded":
+                # the RC already forwarded the create to its owner (with
+                # no reply registration) — retry individually until the
+                # in-flight creation resolves; a plain "exists" with
+                # unresolvable actives means it is still mid-flight, so
+                # poll a few rounds before reporting it
+                deadline = time.time() + timeout
+                while time.time() < deadline:
+                    ack = self.create_name(nm, timeout=retransmit_every * 2)
+                    if ack and (ack.get("ok") or ack.get("reason")
+                                not in (None, "exists")):
+                        results[nm] = ack
+                        break
+                    if ack:
+                        results[nm] = ack
+                    time.sleep(0.25)
+        return results
+
+    def _batch_create_sync(
+        self, rc: int, batch_id: str, creates: List[Dict],
+        timeout: float, retransmit_every: float,
+    ) -> Optional[Dict]:
+        """One batch round with retransmission (idempotent: existing
+        names come back ok/existed).  After two dead attempts the batch
+        rotates to another RC, which degrades gracefully by forwarding
+        each name to its owner."""
+        deadline = time.time() + timeout
+        attempt = 0
+        while time.time() < deadline:
+            target = (rc + (attempt // 2)) % len(self.reconfigurators)
+            attempt += 1
+            ev = threading.Event()
+            box: Dict = {}
+            key = ("create_batch_ack", batch_id)
+            with self._lock:
+                self._rc_waiters[key] = (ev, box)
+            try:
+                self.send_frame(
+                    self.reconfigurators[target],
+                    encode_json("rc_client", self.my_tag, {
+                        "kind": "create_service_batch",
+                        "body": {"batch_id": batch_id, "creates": creates},
+                    }),
+                )
+                if ev.wait(retransmit_every):
+                    return box.get("body", {}).get("results")
+            finally:
+                with self._lock:
+                    self._rc_waiters.pop(key, None)
+        return None
+
+    def send_request_anycast(
+        self,
+        name: str,
+        value: str,
+        callback: Callable,  # cb(request_id, response, error)
+        request_id: Optional[int] = None,
+    ) -> Optional[int]:
+        """Send one request to EVERY active hosting the name; the first
+        responder wins (``sendRequestAnycast``,
+        ``ReconfigurableAppClientAsync.java:798-1404``).  The consensus
+        layer dedupes the duplicate proposals by request id (exactly-once
+        execution); client-side, the callback pops on the first success,
+        and per-active errors surface only if ALL targets fail."""
+        acts = self.request_actives(name)
+        if acts is not None:
+            acts = [a for a in acts if int(a) in self.actives]
+        if not acts:
+            return None
+        if request_id is None:
+            request_id = self.mint_id()
+        n_targets = len(acts)
+        errors: List[str] = []
+        lock = self._lock
+
+        def first_wins(rid, resp, error):
+            if error:
+                with lock:
+                    errors.append(error)
+                    all_failed = len(errors) >= n_targets
+                    if all_failed:
+                        self._callbacks.pop(rid, None)
+                if all_failed:
+                    callback(rid, None, error)
+                return
+            callback(rid, resp, None)
+
+        with self._lock:
+            # n_sends = n_targets disables RTT attribution (ambiguous)
+            self._callbacks[request_id] = (
+                time.time(), first_wins, None, n_targets,
+            )
+        for a in acts:
+            self.send_request_body(self.actives[int(a)], {
+                "name": name, "value": value,
+                "request_id": request_id, "stop": False,
+            })
+        return request_id
 
     def delete_name(self, name: str, timeout: float = 10.0) -> Optional[Dict]:
         ack = self._rc_op_sync(
@@ -302,10 +443,7 @@ class ReconfigurableAppClient(AsyncFrameClient):
             ent = self._callbacks.get(rid)
             if not body.get("error"):
                 self._callbacks.pop(rid, None)
-            cut = now - self.callback_ttl
-            for dead in [r for r in self._callbacks
-                         if self._callbacks[r][0] < cut]:
-                del self._callbacks[dead]
+            self._gc_callbacks_locked(now)
         if ent:
             # RTT attribution only when it is unambiguous: the reply
             # came from the recorded target AND the request was sent

@@ -38,12 +38,18 @@ class FailureDetector:
             timeout_s = Config.get_float(PC.FAILURE_DETECTION_TIMEOUT_S)
         self.timeout_s = timeout_s
         self.long_dead_factor = Config.get_float(PC.COORDINATOR_LONG_DEAD_FACTOR)
+        # explicit ping period if configured; defaults to timeout/2
+        # (FailureDetection.java:62-79)
+        self._ping_period_s = (
+            Config.get_float(PC.PING_PERIOD_S)
+            if Config.is_set(PC.PING_PERIOD_S) else timeout_s / 2.0
+        )
         now = time.time()
         self.last_heard: Dict[int, float] = {int(n): now for n in node_ids}
 
     @property
     def ping_period_s(self) -> float:
-        return self.timeout_s / 2.0
+        return self._ping_period_s
 
     def heard_from(self, node_id: int) -> None:
         self.last_heard[int(node_id)] = time.time()
@@ -75,10 +81,19 @@ class FailureDetector:
              for r in range(R)], bool,
         )
         coord = np.asarray(ballot_coord(np.asarray(bal))) % R
-        coord_down = ~up[coord]
-        coord_long_dead = long_dead[coord]
-        # next-in-line: the cyclically-next member id after the dead coord
         mask = np.asarray(member_mask)
+        # a coordinator that is alive but NOT a member of the group (left
+        # behind by elastic membership churn / a heal that shrank the
+        # set) will never serve it — treat exactly like a dead one, long-
+        # dead included (any member may run; preemption sorts the race).
+        # Without this the group wedges forever: entries forward every
+        # proposal to a node that no longer hosts the row, and no
+        # election ever fires because the node still answers pings
+        # (chaos-soak find, seed 20260730).
+        coord_member = ((mask >> coord) & 1) == 1
+        coord_down = ~up[coord] | ~coord_member
+        coord_long_dead = long_dead[coord] | ~coord_member
+        # next-in-line: the cyclically-next member id after the dead coord
         im_member = ((mask >> self.my_id) & 1) == 1
         next_rr = np.copy(coord)
         for step in range(1, R + 1):

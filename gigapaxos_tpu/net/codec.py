@@ -23,7 +23,7 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
-from ..ops.engine import Blob, EngineConfig
+from ..ops.engine import Blob, EngineConfig, _leaf_shapes, blob_vec_len
 
 _BHDR = struct.Struct(">cIQ")  # kind, sender, tick
 
@@ -43,13 +43,9 @@ def decode_json(payload: bytes) -> Tuple[str, int, Dict]:
 
 
 def blob_shapes(cfg: EngineConfig):
-    G, W = cfg.n_groups, cfg.window
-    return {
-        name: (G,)
-        if name in ("tag", "bal", "exec_slot", "prep_bal", "prop_bal")
-        else (G, W)
-        for name in Blob._fields
-    }
+    # derived from the engine's leaf table so the per-leaf codec and the
+    # packed-vector codec can never disagree on the wire layout
+    return dict(_leaf_shapes(Blob._fields, cfg))
 
 
 def encode_blob(sender: int, tick: int, blob: Blob) -> bytes:
@@ -57,6 +53,31 @@ def encode_blob(sender: int, tick: int, blob: Blob) -> bytes:
     for leaf in blob:
         parts.append(np.asarray(leaf, np.int32).tobytes())
     return b"".join(parts)
+
+
+def encode_blob_vec(sender: int, tick: int, vec: np.ndarray) -> bytes:
+    """Packed-vector fast path: `vec` is already the frame body (leaf
+    C-order ravels in ``Blob._fields`` order — identical bytes to
+    :func:`encode_blob`)."""
+    return _BHDR.pack(b"C", sender, tick) + np.ascontiguousarray(
+        vec, np.int32
+    ).tobytes()
+
+
+def decode_blob_vec(
+    payload: bytes, cfg: EngineConfig
+) -> Tuple[int, int, np.ndarray]:
+    """Zero-split decode for the packed tick path: the frame body IS the
+    [N] gathered-row vector.  Same size check as :func:`decode_blob`."""
+    kind, sender, tick = _BHDR.unpack_from(payload, 0)
+    assert kind == b"C"
+    n = blob_vec_len(cfg)
+    if len(payload) != _BHDR.size + 4 * n:
+        raise ValueError(
+            f"blob frame size {len(payload)} != expected "
+            f"{_BHDR.size + 4 * n} (peer blob-schema/config mismatch)"
+        )
+    return sender, tick, np.frombuffer(payload, np.int32, offset=_BHDR.size)
 
 
 def decode_blob(payload: bytes, cfg: EngineConfig) -> Tuple[int, int, Blob]:

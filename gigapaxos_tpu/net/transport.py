@@ -171,11 +171,15 @@ class MessageTransport:
             self.node_config.get_node_address(node_id), payload
         )
 
-    def send_to_address(self, addr: Tuple[str, int], payload: bytes) -> bool:
+    def send_to_address(self, addr: Tuple[str, int], payload: bytes,
+                        delay: float = 0.0) -> bool:
+        """Queue a frame; `delay` postpones the enqueue (chunk pacing /
+        emulation) on top of any configured delay_fn link delay."""
         if self._stopped:
             return False
         addr = (addr[0], int(addr[1]))
-        delay = self.delay_fn(addr) if self.delay_fn is not None else 0.0
+        if self.delay_fn is not None:
+            delay += self.delay_fn(addr)
         if delay > 0:
             self._loop.call_soon_threadsafe(
                 self._loop.call_later, delay, self._enqueue, addr, payload

@@ -534,3 +534,107 @@ def step(
         preempted_vid=jnp.where(m2, preempted_vid, NULL),
     )
     return new_state, outputs
+
+
+# ---------------------------------------------------------------------------
+# Packed host-exchange interface.
+#
+# The deployed (socket/loopback) runtime moves every blob leaf host<->device
+# each tick.  Doing that as ~50 per-leaf jnp.asarray / device_put / asarray
+# dispatches costs far more than the engine step itself at loopback scale
+# (it was ~70% of a node's tick on a 1-core host).  These helpers move each
+# direction as ONE int32 vector: the gathered peer blobs upload as a single
+# [R, N] array (sliced back into Blob leaves INSIDE the jitted step, where
+# the slices fuse for free), and the step's outputs + fresh publish blob
+# come back as single vectors split into numpy views on the host.
+#
+# The vector layout intentionally equals the ``C`` wire frame body
+# (Blob._fields order, C-order ravel): a received frame's payload IS the
+# packed row, byte-for-byte, so the transport needs no re-packing either.
+# ---------------------------------------------------------------------------
+
+def _leaf_shapes(fields, cfg: EngineConfig):
+    G, W = cfg.n_groups, cfg.window
+    return [
+        (name, (G,) if name in _G_LEAVES else (G, W)) for name in fields
+    ]
+
+
+# [G]-shaped leaves across Blob and StepOutputs (everything else is [G, W])
+_G_LEAVES = frozenset((
+    "tag", "bal", "exec_slot", "prep_bal", "prop_bal",
+    "n_committed", "exec_base", "n_admitted", "maj_exec", "app_hash",
+    "bal_new",
+))
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def blob_vec_len(cfg: EngineConfig) -> int:
+    # memoized: recomputing the shape walk on every received frame would
+    # tax the exact hot path the packed codec exists to relieve
+    return sum(
+        int(np.prod(s)) for _n, s in _leaf_shapes(Blob._fields, cfg)
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def out_vec_len(cfg: EngineConfig) -> int:
+    return sum(
+        int(np.prod(s)) for _n, s in _leaf_shapes(StepOutputs._fields, cfg)
+    )
+
+
+def pack_blob(blob: Blob) -> jnp.ndarray:
+    """[N] device vector in Blob._fields order (== wire frame body)."""
+    return jnp.concatenate([jnp.ravel(leaf) for leaf in blob])
+
+
+def _unpack(vec, fields, cfg: EngineConfig, cls, batched: bool):
+    leaves = []
+    off = 0
+    for name, shape in _leaf_shapes(fields, cfg):
+        n = int(np.prod(shape))
+        chunk = vec[..., off:off + n]
+        off += n
+        full = (vec.shape[0],) + shape if batched else shape
+        leaves.append(chunk.reshape(full))
+    return cls(*leaves)
+
+
+def unpack_gathered(gvec: jnp.ndarray, cfg: EngineConfig) -> Blob:
+    """[R, N] packed peer blobs -> Blob of [R, ...] leaves (inside jit)."""
+    return _unpack(gvec, Blob._fields, cfg, Blob, batched=True)
+
+
+def split_out_vec(vec: np.ndarray, cfg: EngineConfig) -> StepOutputs:
+    """Host-side: one transferred [M] vector -> StepOutputs of np views."""
+    return _unpack(
+        np.asarray(vec), StepOutputs._fields, cfg, StepOutputs, batched=False
+    )
+
+
+def split_blob_vec(vec: np.ndarray, cfg: EngineConfig) -> Blob:
+    return _unpack(
+        np.asarray(vec), Blob._fields, cfg, Blob, batched=False
+    )
+
+
+def step_host(
+    state: EngineState,
+    gvec: jnp.ndarray,       # [R, N] packed gathered blobs
+    heard: jnp.ndarray,
+    req_vid: jnp.ndarray,
+    want_coord: jnp.ndarray,
+    my_id: jnp.ndarray,
+    *,
+    cfg: EngineConfig,
+):
+    """One step over packed I/O: returns (state', out_vec, blob_vec)."""
+    g = unpack_gathered(gvec, cfg)
+    new_state, out = step(state, g, heard, req_vid, want_coord, my_id, cfg=cfg)
+    out_vec = jnp.concatenate([jnp.ravel(leaf) for leaf in out])
+    blob_vec = pack_blob(make_blob(new_state))
+    return new_state, out_vec, blob_vec

@@ -24,11 +24,11 @@ class PC(FlagEnum):
     # allocated dense engine rows for a deployed node (HBM/RAM cost is
     # O(ENGINE_ROWS * SLOT_WINDOW)); PINSTANCES_CAPACITY above is the
     # design CEILING (2M ref parity) — raise ENGINE_ROWS toward it on TPU
+    # (GROUP_BLOCK and ENGINE_DTYPE were dropped: the engine is int32 by
+    # design and row capacity needs no padding quantum — a flag that
+    # promises an unimplemented capability is worse than none)
     ENGINE_ROWS = 65536
     SLOT_WINDOW = 16                     # W: in-flight slots per group (ring buffer)
-    DEFAULT_NUM_REPLICAS = 3
-    GROUP_BLOCK = 1024                   # group-count padding quantum (lane friendliness)
-    ENGINE_DTYPE = "int32"
 
     # ---- batching (ref: RequestBatcher / PaxosPacketBatcher) ----------
     BATCHING_ENABLED = True
@@ -41,7 +41,7 @@ class PC(FlagEnum):
     MAX_LOG_FILE_SIZE = 64 * 1024 * 1024
     MAX_LOG_MESSAGE_SIZE = 5 * 1024 * 1024
     CHECKPOINT_INTERVAL = 400            # slots between app checkpoints
-    JOURNAL_GC_FREQUENCY = 100
+    JOURNAL_GC_FREQUENCY = 1             # GC every Nth checkpoint
     PAXOS_LOGS_DIR = "paxos_logs"
 
     # ---- liveness (ref: PaxosConfig.java:668; FailureDetection.java:62-79)
@@ -72,9 +72,23 @@ class PC(FlagEnum):
     LAZY_PROPAGATION = False
 
     # ---- transport ------------------------------------------------------
+    # (CHARSET was dropped: the wire is JSON/UTF-8 + packed int32 tensors
+    # by design — a charset knob could only corrupt it)
     CLIENT_PORT_OFFSET = 100             # ref: ReconfigurationConfig port offsets
     HTTP_PORT_OFFSET = 300
-    CHARSET = "ISO-8859-1"
+
+    # ---- TLS (ref: SSL modes CLEAR/SERVER_AUTH/MUTUAL_AUTH,
+    # SSLDataProcessingWorker.java:59, PaxosConfig.java:548-553; key
+    # material as PEM paths instead of JKS keystores).  Setting
+    # CLIENT_SSL_MODE opens a SEPARATE client-facing listener at
+    # port + CLIENT_PORT_OFFSET running that mode (the reference's
+    # per-plane port split: e.g. a MUTUAL_AUTH server mesh with
+    # SERVER_AUTH clients).
+    SSL_MODE = "CLEAR"                   # CLEAR | SERVER_AUTH | MUTUAL_AUTH
+    CLIENT_SSL_MODE = ""                 # "" = clients share the mesh port
+    SSL_KEY_FILE = ""                    # this node's private key (PEM)
+    SSL_CERT_FILE = ""                   # this node's certificate (PEM)
+    SSL_CA_FILE = ""                     # trust anchors (PEM bundle)
 
 
 Config.register(PC)

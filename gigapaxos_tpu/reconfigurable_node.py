@@ -44,8 +44,8 @@ from .utils.config import Config
 
 # reconfigurator-plane kinds a client may send to an RC
 RC_CLIENT_KINDS = (
-    "create_service", "delete_service", "reconfigure", "request_actives",
-    "add_active", "remove_active",
+    "create_service", "create_service_batch", "delete_service",
+    "reconfigure", "request_actives", "add_active", "remove_active",
 )
 
 
@@ -70,9 +70,9 @@ class _EpochSender:
         frame = encode_json(
             "epoch", self.server.my_id, {"kind": kind, "body": body}
         )
-        self.server.transport.send_to_address(
-            book.get_node_address(nid), frame
-        )
+        # streams oversize frames (epoch_final_state can carry a multi-MB
+        # app checkpoint — LargeCheckpointer territory)
+        self.server.send_frame_to_address(book.get_node_address(nid), frame)
 
 
 class ActiveReplicaServer(PaxosServer):

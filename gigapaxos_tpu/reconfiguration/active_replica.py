@@ -104,6 +104,13 @@ class ActiveReplica:
             RC.DEMAND_REPORT_PERIOD_S
         )
         self.demand_report_every = Config.get_int(RC.DEMAND_REPORT_EVERY)
+        # retention cap for served epoch-final states (MAX_FINAL_STATE_AGE
+        # 3600s, ReconfigurationConfig analog): the explicit drop rounds
+        # GC them normally — this ages out snapshots whose drop never
+        # arrived (e.g. the RC died mid-reconfiguration)
+        self.max_final_state_age_s = Config.get_float(
+            RC.MAX_FINAL_STATE_AGE_S
+        )
         self._last_demand_flush = time.time()
         self.tasks = ProtocolExecutor(
             send=lambda m: self.send(m[0], m[1], m[2])
@@ -144,6 +151,12 @@ class ActiveReplica:
         self.tasks.tick(now)
         self._maybe_sweep(now)
         self._maybe_report_demand(now)
+        # age out final-state snapshots whose drop round never arrived
+        if self.final_states:
+            cut = (now or time.time()) - self.max_final_state_age_s
+            for k in [k for k, s in self.final_states.items()
+                      if s.get("t", 0) < cut]:
+                del self.final_states[k]
 
     # ---- demand reporting (updateDemandStats -> DemandReport,
     # ActiveReplica demand hooks / DemandReport.java) --------------------
@@ -339,6 +352,7 @@ class ActiveReplica:
         self.final_states[(name, epoch)] = {
             "state": self.coordinator.app.checkpoint(name),
             "dedup": self.coordinator.dedup_for_name(name),
+            "t": time.time(),
         }
         for rc in self._pending_stop_acks.pop((name, epoch), []):
             self._ack_stop(rc, name, epoch)
@@ -370,6 +384,7 @@ class ActiveReplica:
             snap = {
                 "state": self.coordinator.app.checkpoint(name),
                 "dedup": self.coordinator.dedup_for_name(name),
+                "t": time.time(),
             }
             self.final_states[key] = snap
         self.send(("AR", int(body["from"])), "epoch_final_state", {

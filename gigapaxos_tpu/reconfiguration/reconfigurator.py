@@ -437,6 +437,15 @@ class Reconfigurator:
         # last row-probe attempt per name: an expired start task's re-drive
         # resumes probing here instead of restarting at attempt 0
         self._last_attempt: Dict[str, int] = {}
+        # batched creates (Reconfigurator.java:484-680 batch path):
+        # batch_id -> {client, pending names, per-name results}; one
+        # create_batch_ack per batch when every member settles.  In-memory
+        # like _pending_clients — a client retransmit rebuilds it.
+        self._batches: Dict[str, Dict] = {}
+        # name -> batch ids awaiting it (a SET: two concurrent batches may
+        # both contain the same in-flight name; completing one must not
+        # strand the other)
+        self._batch_of: Dict[str, set] = {}
         self._tick_count = 0
         rc_app.on_applied = self._on_applied
         rc_app.on_restored = self._refresh_ar_ring
@@ -471,6 +480,8 @@ class Reconfigurator:
     def handle_message(self, kind: str, body: Dict, frm: Optional[Any] = None) -> None:
         if kind == "create_service":
             self._handle_create(body)
+        elif kind == "create_service_batch":
+            self._handle_create_batch(body)
         elif kind == "delete_service":
             self._handle_delete(body)
         elif kind == "reconfigure":
@@ -556,36 +567,114 @@ class Reconfigurator:
             )
 
     # ---- create (handleCreateServiceName, Reconfigurator.java:484) -----
+    def _create_locally(
+        self, name: str, actives: Optional[List[int]],
+        initial_state: Optional[str],
+    ):
+        """Shared create core: returns "pending" (CREATE_INTENT proposed),
+        "inflight" (an identical creation already mid-flight), or a dict
+        result for an immediate answer."""
+        rec = self.rc_app.get_record(name)
+        if rec is not None and not rec.deleted:
+            if rec.state is RCState.WAIT_ACK_START and not rec.actives:
+                return "inflight"
+            return {"ok": False, "reason": "exists", "actives": rec.actives}
+        actives = actives or self.ar_ring.get_replicated_servers(
+            name, self.default_replicas
+        )
+        if self._bad_actives(actives):
+            return {"ok": False, "reason": "bad-actives"}
+        self.propose_op({
+            "op": CREATE_INTENT, "name": name, "epoch": 0,
+            "actives": actives, "row": row_for(name, 0, 0, self.n_groups),
+            "initial_state": initial_state,
+        })
+        return "pending"
+
     def _handle_create(self, body: Dict) -> None:
         name = body["name"]
         if not self.is_primary(name):
             # forward to the owner (the reference redirects via the ring)
             self.send(("RC", self.primary_of(name)), "create_service", body)
             return
-        rec = self.rc_app.get_record(name)
-        if rec is not None and not rec.deleted:
-            if rec.state is RCState.WAIT_ACK_START and not rec.actives:
-                # creation still in flight: a client retransmit re-registers
-                # for the eventual COMPLETE reply instead of a false "exists"
-                if body.get("client") is not None:
-                    self._pending_clients[name] = body["client"]
-                return
-            self._reply(body, "create_ack", name, ok=False, reason="exists")
-            return
-        actives = body.get("actives") or self.ar_ring.get_replicated_servers(
-            name, self.default_replicas
+        status = self._create_locally(
+            name, body.get("actives"), body.get("initial_state")
         )
-        if self._bad_actives(actives):
-            self._reply(body, "create_ack", name, ok=False,
-                        reason="bad-actives")
+        if status in ("pending", "inflight"):
+            # client answered at COMPLETE (a retransmit during an
+            # in-flight creation re-registers instead of a false "exists")
+            if body.get("client") is not None:
+                self._pending_clients[name] = body["client"]
             return
-        if body.get("client") is not None:
-            self._pending_clients[name] = body["client"]
-        self.propose_op({
-            "op": CREATE_INTENT, "name": name, "epoch": 0,
-            "actives": actives, "row": row_for(name, 0, 0, self.n_groups),
-            "initial_state": body.get("initial_state"),
-        })
+        self._reply(body, "create_ack", name,
+                    **{k: v for k, v in status.items() if k != "actives"})
+
+    def _handle_create_batch(self, body: Dict) -> None:
+        """Batched creates (the reference's batched CreateServiceName
+        split by RC group: ``Reconfigurator.java:484-680``,
+        ``CreateServiceName.java`` nested name-states): N names cost the
+        client ONE round trip to this RC instead of N.  Names that hash
+        to another RC (client ring drift) are forwarded singly and
+        reported ``forwarded`` — the client retries those individually."""
+        batch_id = str(body.get("batch_id"))
+        ent = self._batches.get(batch_id)
+        if ent is None:
+            ent = self._batches[batch_id] = {
+                "client": body.get("client"), "pending": set(), "results": {},
+            }
+        elif body.get("client") is not None:
+            ent["client"] = body["client"]  # retransmit re-registers
+        for c in body.get("creates", ()):
+            name = c.get("name")
+            if not name or name in ent["pending"]:
+                continue
+            if not self.is_primary(name):
+                self.send(("RC", self.primary_of(name)), "create_service", {
+                    "name": name, "actives": c.get("actives"),
+                    "initial_state": c.get("initial_state"),
+                })
+                ent["results"][name] = {"ok": False, "reason": "forwarded"}
+                continue
+            status = self._create_locally(
+                name, c.get("actives"), c.get("initial_state")
+            )
+            if status in ("pending", "inflight"):
+                ent["pending"].add(name)
+                self._batch_of.setdefault(name, set()).add(batch_id)
+            elif status.get("reason") == "exists":
+                # idempotent batch retransmit: an existing name is success
+                ent["results"][name] = {
+                    "ok": True, "existed": True,
+                    "actives": status.get("actives"),
+                }
+            else:
+                ent["results"][name] = status
+        self._maybe_finish_batch(batch_id)
+
+    def _note_batch_done(self, name: str, **fields) -> None:
+        bids = self._batch_of.pop(name, None)
+        if not bids:
+            return
+        for bid in bids:
+            ent = self._batches.get(bid)
+            if ent is None:
+                continue
+            ent["pending"].discard(name)
+            ent["results"][name] = fields
+            self._maybe_finish_batch(bid)
+
+    def _maybe_finish_batch(self, bid: str) -> None:
+        ent = self._batches.get(bid)
+        if ent is None or ent["pending"]:
+            return
+        del self._batches[bid]
+        client = ent.get("client")
+        if client is not None:
+            # "name" carries the batch id: the client's waiter table keys
+            # acks by (kind, name)
+            self.send(tuple(client), "create_batch_ack", {
+                "name": bid, "batch_id": bid, "results": ent["results"],
+            })
 
     # ---- reconfigure (epoch e -> e+1, §3.5) ----------------------------
     def _handle_reconfigure(self, body: Dict) -> None:
@@ -999,6 +1088,9 @@ class Reconfigurator:
                           "create_ack" if was_create else "reconfigure_ack",
                           {"name": name, "ok": True, "actives": rec.actives,
                            "epoch": rec.epoch})
+            self._note_batch_done(
+                name, ok=True, actives=rec.actives, epoch=rec.epoch
+            )
             self._last_attempt.pop(name, None)  # probe settled
             # lift the pre-COMPLETE admission gate on every new active
             ckey = f"commit:{name}:{rec.epoch}:{rec.row}"

@@ -21,22 +21,20 @@ import threading
 import time
 from typing import Callable, Dict, Optional, Tuple
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 
 from .failure_detection import FailureDetector
 from .manager import PaxosManager
 from .net.codec import (
-    decode_blob,
+    decode_blob_vec,
     decode_json,
     decode_kind,
-    encode_blob,
+    encode_blob_vec,
     encode_json,
 )
 from .net.node_config import NodeConfig
 from .net.transport import MessageTransport
-from .ops.engine import Blob, EngineConfig
+from .ops.engine import EngineConfig
 from .paxos_config import PC
 from .utils.config import Config
 
@@ -56,7 +54,33 @@ class PaxosServer:
         self.node_config = node_config
         self.cfg = cfg
         self.manager = PaxosManager(my_id, app, cfg, log_dir=log_dir)
-        self.transport = MessageTransport(my_id, node_config, self._on_message)
+        # TLS per the configured SSL_MODE (CLEAR/SERVER_AUTH/MUTUAL_AUTH,
+        # SSLDataProcessingWorker.java:59 analog)
+        from .net.ssl_util import (
+            build_client_plane_contexts,
+            build_ssl_contexts,
+            client_plane_split,
+        )
+
+        ssl_server, ssl_client = build_ssl_contexts()
+        self.transport = MessageTransport(
+            my_id, node_config, self._on_message,
+            ssl_server_context=ssl_server, ssl_client_context=ssl_client,
+        )
+        # per-plane port split (PaxosConfig.java:219-224): when
+        # CLIENT_SSL_MODE is set, clients speak to a SEPARATE listener at
+        # port + CLIENT_PORT_OFFSET under that mode (e.g. a MUTUAL_AUTH
+        # mesh serving SERVER_AUTH clients)
+        self.client_transport: Optional[MessageTransport] = None
+        if client_plane_split():
+            c_srv, c_cli = build_client_plane_contexts()
+            host, port = node_config.get_node_address(my_id)
+            self.client_transport = MessageTransport(
+                my_id, node_config, self._on_client_plane_message,
+                listen_host=host,
+                listen_port=int(port) + Config.get_int(PC.CLIENT_PORT_OFFSET),
+                ssl_server_context=c_srv, ssl_client_context=c_cli,
+            )
         self.fd = FailureDetector(my_id, node_config.get_node_ids(), fd_timeout_s)
         self.tick_interval = (
             Config.get_float(PC.TICK_INTERVAL_S)
@@ -70,8 +94,10 @@ class PaxosServer:
         # trade the reference's sleep tuning makes
         self._batching = Config.get_bool(PC.BATCHING_ENABLED)
         self._batch_sleep_s = Config.get_float(PC.BATCH_SLEEP_MS) / 1000.0
-        self._peer_blobs: Dict[int, Blob] = {}
+        self._peer_blobs: Dict[int, np.ndarray] = {}  # packed [N] vectors
         self._blob_lock = threading.Lock()
+        self._my_blob_vec: Optional[np.ndarray] = None
+        self._my_blob_state = None
         self._tick = 0
         self._last_ping = 0.0
         self._stop = threading.Event()
@@ -106,6 +132,19 @@ class PaxosServer:
         # boundary — on a small host, per-response frames dominate CPU)
         self._resp_lock = threading.Lock()
         self._resp_buf: Dict[int, Tuple[Callable, list]] = {}
+        # large-message streaming (LargeCheckpointer analog,
+        # LargeCheckpointer.java:43 / CheckpointServer:1237): a control
+        # frame above MAX_LOG_MESSAGE_SIZE is split into paced chunk
+        # frames so a multi-MB app state never monopolizes a peer link
+        # and stalls the epoch/consensus planes; the receiver reassembles
+        # and re-dispatches the original frame
+        self.max_frame_bytes = Config.get_int(PC.MAX_LOG_MESSAGE_SIZE)
+        self.CHUNK_BYTES = 512 * 1024
+        self.CHUNK_PACE_S = 0.002  # per-chunk stagger: lets other frames in
+        self._xfer_seq = 0
+        self._chunk_lock = threading.Lock()
+        # (sender, xfer id) -> {"n": total, "parts": {i: bytes}, "t": time}
+        self._chunk_rx: Dict[Tuple[int, str], Dict] = {}
         self._thread = threading.Thread(
             target=self._run, name=f"paxos-server-{my_id}", daemon=True
         )
@@ -113,6 +152,8 @@ class PaxosServer:
     # ---- lifecycle -----------------------------------------------------
     def start(self) -> None:
         self.transport.start()
+        if self.client_transport is not None:
+            self.client_transport.start()
         self._thread.start()
 
     def stop(self) -> None:
@@ -120,15 +161,42 @@ class PaxosServer:
         self._kick.set()  # wake a sleeping tick loop so the join is quick
         self._thread.join(timeout=10)
         self.transport.stop()
+        if self.client_transport is not None:
+            self.client_transport.stop()
         self.manager.close()
+
+    # frame kinds a CLIENT-plane connection may deliver: anything else
+    # (blobs, payload gossip, forwards, state transfer, chunks, epoch
+    # control) is mesh traffic — accepting it from the weaker-auth client
+    # listener would let a cert-less client inject consensus state and
+    # defeat the MUTUAL_AUTH mesh split
+    CLIENT_PLANE_KINDS = frozenset((
+        "client_request", "client_request_batch", "rc_client",
+        "admin", "fd_ping",
+    ))
+
+    def _on_client_plane_message(
+        self, payload: bytes, peer: Tuple[str, int], reply
+    ) -> None:
+        if decode_kind(payload) != "J":
+            return  # packed consensus blobs never come from clients
+        try:
+            k, sender, body = decode_json(payload)
+        except (ValueError, KeyError):
+            return
+        if k not in self.CLIENT_PLANE_KINDS:
+            return
+        self._on_json(k, sender, body, reply)
+        if k != "fd_ping":
+            self._kick.set()
 
     # ---- message ingress (demultiplexer analog) ------------------------
     def _on_message(self, payload: bytes, peer: Tuple[str, int], reply) -> None:
         kind = decode_kind(payload)
         if kind == "C":
-            sender, _tick, blob = decode_blob(payload, self.cfg)
+            sender, _tick, vec = decode_blob_vec(payload, self.cfg)
             with self._blob_lock:
-                self._peer_blobs[sender] = blob
+                self._peer_blobs[sender] = vec
                 self._blob_dirty = True
             self.fd.heard_from(sender)
             m = self.manager
@@ -157,6 +225,8 @@ class PaxosServer:
         if k in ("payloads", "forward", "need_payloads",
                  "state_request", "state_reply"):
             self.manager.on_host_message(k, body)
+        elif k == "chunk":
+            self._on_chunk(sender, body, reply)
         elif k == "fd_ping":
             pass  # hearing it is the point (any traffic counts as alive)
         elif k == "client_request":
@@ -174,6 +244,69 @@ class PaxosServer:
         else:
             return False
         return True
+
+    # ---- large-frame streaming ----------------------------------------
+    def send_frame_to_address(self, addr, frame: bytes) -> None:
+        """Send a control frame, streaming it as paced chunks when it
+        exceeds MAX_LOG_MESSAGE_SIZE (the frame-size cap the reference
+        enforces at the NIO payload boundary)."""
+        if len(frame) <= self.max_frame_bytes:
+            self.transport.send_to_address(addr, frame)
+            return
+        import base64
+
+        with self._chunk_lock:
+            self._xfer_seq += 1
+            xfer = f"{self.my_id}:{self._xfer_seq}"
+        n = (len(frame) + self.CHUNK_BYTES - 1) // self.CHUNK_BYTES
+        for i in range(n):
+            part = frame[i * self.CHUNK_BYTES:(i + 1) * self.CHUNK_BYTES]
+            chunk = encode_json("chunk", self.my_id, {
+                "x": xfer, "i": i, "n": n,
+                "d": base64.b64encode(part).decode("ascii"),
+            })
+            # pace the pieces: frames enqueued between two chunks (blobs,
+            # client traffic) interleave instead of waiting out the
+            # whole multi-MB transfer
+            self.transport.send_to_address(
+                addr, chunk, delay=i * self.CHUNK_PACE_S
+            )
+
+    def send_frame_to_id(self, node_id: int, frame: bytes) -> None:
+        if node_id in self.node_config:
+            self.send_frame_to_address(
+                self.node_config.get_node_address(node_id), frame
+            )
+
+    def _on_chunk(self, sender: int, body: Dict, reply) -> None:
+        import base64
+
+        key = (sender, str(body["x"]))
+        now = time.time()
+        with self._chunk_lock:
+            ent = self._chunk_rx.get(key)
+            if ent is None:
+                ent = self._chunk_rx[key] = {
+                    "n": int(body["n"]), "parts": {}, "t": now,
+                }
+            ent["t"] = now  # refresh: an ACTIVE slow transfer must not GC
+            ent["parts"][int(body["i"])] = base64.b64decode(body["d"])
+            done = len(ent["parts"]) == ent["n"]
+            if done:
+                del self._chunk_rx[key]
+            # GC abandoned transfers (a crashed sender must not leak RAM)
+            if len(self._chunk_rx) > 4 or now - getattr(
+                self, "_last_chunk_gc", 0
+            ) > 30:
+                self._last_chunk_gc = now
+                for k in [k for k, e in self._chunk_rx.items()
+                          if now - e["t"] > 60]:
+                    del self._chunk_rx[k]
+        if done:
+            frame = b"".join(
+                ent["parts"][i] for i in range(ent["n"])
+            )
+            self._on_message(frame, ("chunk", sender), reply)
 
     def _buffer_response(self, reply, item: Dict) -> None:
         with self._resp_lock:
@@ -301,35 +434,44 @@ class PaxosServer:
 
     def tick_once(self) -> None:
         R = self.cfg.n_replicas
-        # one device->host sync per leaf for my blob (reused below for the
-        # publish frame), then stack in NUMPY and upload once per leaf —
-        # per-peer jnp.asarray + jnp.stack costs 3x the device ops and
-        # dominated the tick at small G (it made the loopback round trip
-        # ~10x the engine time)
-        my_blob = jax.tree.map(np.asarray, self.manager.blob())
+        # packed exchange: peer frames already ARE the [N] vectors, my
+        # previous tick's publish vector is cached, and the whole [R, N]
+        # gather uploads as ONE device put inside the packed step (the
+        # per-leaf dispatch path cost ~3x the engine step at small G)
+        if self._my_blob_state is not self.manager.state:
+            # state changed outside the tick (create/kill/resume/recover):
+            # the cached publish vector is stale — my own gathered row
+            # must reflect the CURRENT state (tags/membership included).
+            # The pair is captured atomically under the manager lock, so
+            # a concurrent lifecycle op can never mispair them.
+            self._my_blob_vec, self._my_blob_state = (
+                self.manager.publish_snapshot()
+            )
+        my_vec = self._my_blob_vec
         with self._blob_lock:
-            peer_blobs = dict(self._peer_blobs)
+            peer_vecs = dict(self._peer_blobs)
             self._blob_dirty = False
         rows, heard = [], np.zeros(R, bool)
         for r in range(R):
             if r == self.my_id:
-                rows.append(my_blob)
+                rows.append(my_vec)
                 heard[r] = True
-            elif r in peer_blobs:
-                rows.append(peer_blobs[r])
+            elif r in peer_vecs:
+                rows.append(peer_vecs[r])
                 heard[r] = True
             else:
-                rows.append(my_blob)
-        gathered = Blob(*(
-            jnp.asarray(np.stack([np.asarray(row[i]) for row in rows]))
-            for i in range(len(Blob._fields))
-        ))
+                rows.append(my_vec)
+        gathered = np.stack(rows)
         want = self.fd.want_coord(
             self.manager._np("bal"),
             self.manager._np("member_mask"),
             R,
         )
-        blob, delta = self.manager.tick(gathered, heard, want)
+        blob_vec, blob_state, delta = self.manager.tick_host(
+            gathered, heard, want
+        )
+        self._my_blob_vec = blob_vec
+        self._my_blob_state = blob_state
         self._tick += 1
         m = self.manager
         progressed = m.last_progress_tick == m._tick_no
@@ -354,9 +496,7 @@ class PaxosServer:
             time.monotonic() - self._last_publish > self.IDLE_REPUBLISH_S
         ):
             self._last_publish = time.monotonic()
-            blob_frame = encode_blob(
-                self.my_id, self._tick, jax.tree.map(np.asarray, blob)
-            )
+            blob_frame = encode_blob_vec(self.my_id, self._tick, blob_vec)
             for r in peers:
                 self.transport.send_to_id(r, blob_frame)
         if delta["arena"] or delta.get("app_exec"):
@@ -366,13 +506,15 @@ class PaxosServer:
         fwd = self.manager.drain_forward_out()
         for dst, k, body in fwd:
             frame = encode_json(k, self.my_id, body)
+            # send_frame_to_id streams oversize frames (a multi-MB
+            # state_reply must not monopolize the link)
             if dst == -1:
                 for r in peers:
-                    self.transport.send_to_id(r, frame)
+                    self.send_frame_to_id(r, frame)
             elif dst == self.my_id:
                 self.manager.on_host_message(k, body)
             else:
-                self.transport.send_to_id(dst, frame)
+                self.send_frame_to_id(dst, frame)
 
         self._maybe_ping()
         self._layer_tick()

@@ -77,6 +77,14 @@ class PaxosLogger:
         # open group-commit batch (BatchedLogger analog): log_* calls
         # buffer here and leave in ONE writev/fsync at scope exit
         self._batch: Optional[List] = None
+        # journal GC runs every Nth checkpoint (JOURNAL_GC_FREQUENCY
+        # analog; default 1 = GC at every checkpoint — raise to amortize
+        # the file scan on checkpoint-heavy deployments)
+        from ..paxos_config import PC
+        from ..utils.config import Config
+
+        self.gc_every = max(1, Config.get_int(PC.JOURNAL_GC_FREQUENCY))
+        self._ckpts_since_gc = 0
 
     @contextlib.contextmanager
     def batch(self):
@@ -194,7 +202,10 @@ class PaxosLogger:
             BlockType.CHECKPOINT,
             json.dumps({"journal_pos": list(pos)}).encode("utf-8"),
         )
-        self.journal.gc_below(pos[0])
+        self._ckpts_since_gc += 1
+        if self._ckpts_since_gc >= self.gc_every:
+            self._ckpts_since_gc = 0
+            self.journal.gc_below(pos[0])
 
     # ---- recovery ------------------------------------------------------
     def recover(

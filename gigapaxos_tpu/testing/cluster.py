@@ -43,6 +43,19 @@ class ManagerCluster:
         self.blobs: List[Blob] = [m.blob() for m in self.managers]
         # host-channel inboxes: (kind, body) per receiver
         self.inboxes: List[List] = [[] for _ in range(R)]
+        # default election drive (the deployed server's FailureDetector)
+        # with an INFINITE timeout: stepped clusters exchange no pings, so
+        # a finite timeout would make every node look dead after a few
+        # wall-clock seconds and storm elections.  With everyone forever
+        # "up", the mask fires ONLY for groups whose ballot coordinator is
+        # not a member (elastic-membership leftovers, the chaos-soak
+        # 20260730 wedge) — explicit want_coord args override.
+        from ..failure_detection import FailureDetector
+
+        self._fds = [
+            FailureDetector(r, range(R), timeout_s=float("inf"))
+            for r in range(R)
+        ]
 
     # ---- lifecycle across the cluster ---------------------------------
     def create(self, name: str, members: Optional[List[int]] = None,
@@ -87,9 +100,13 @@ class ManagerCluster:
                 heard[j] = live
                 rows.append(self.blobs[j] if live else self.blobs[i])
             gathered = jax.tree.map(lambda *xs: jnp.stack(xs), *rows)
-            blob, delta = self.managers[i].tick(
-                gathered, heard, want_coord.get(i)
-            )
+            want = want_coord.get(i)
+            if want is None:
+                m = self.managers[i]
+                want = self._fds[i].want_coord(
+                    m._np("bal"), m._np("member_mask"), R
+                )
+            blob, delta = self.managers[i].tick(gathered, heard, want)
             new_blobs[i] = blob
             deltas.append(delta)
         self.blobs = new_blobs

@@ -40,6 +40,10 @@ def main() -> int:
     ap.add_argument("--max-rounds", type=int, default=12)
     ap.add_argument("--cpu", action="store_true",
                     help="pin the JAX backend to CPU")
+    ap.add_argument("--unreplicated", action="store_true",
+                    help="EMULATE_UNREPLICATED attribution mode "
+                         "(PaxosManager.java:1731): answer at the entry "
+                         "without consensus, isolating app+wire cost")
     ap.add_argument("--in-process", action="store_true",
                     help="all nodes in this process (default: one OS "
                          "process per node — the realistic deployment "
@@ -62,6 +66,9 @@ def main() -> int:
     for i in range(3):
         Config.set(f"active.AR{i}", f"127.0.0.1:{ports[i]}")
         Config.set(f"reconfigurator.RC{i}", f"127.0.0.1:{ports[3 + i]}")
+    if args.unreplicated:
+        Config.set("EMULATE_UNREPLICATED", "true")
+        os.environ["GP_EMULATE_UNREPLICATED"] = "true"  # child processes
     node_names = [f"{r}{i}" for r in ("AR", "RC") for i in range(3)]
     nodes = []
     procs = []
@@ -228,11 +235,13 @@ def main() -> int:
                 break
             capacity = rate
             rate *= args.factor
+        mode = "unreplicated (app+wire only)" if args.unreplicated \
+            else "full system path"
         print(json.dumps({
             "metric": "system_capacity_requests_per_s",
             "value": round(capacity, 1),
             "unit": f"req/s ({args.groups} groups, 3 actives + 3 RCs, "
-                    "loopback sockets, full system path)",
+                    f"loopback sockets, {mode})",
             "protocol": f"x{args.factor} until resp<{args.threshold} "
                         f"or latency>{args.latency_ms}ms",
         }), flush=True)

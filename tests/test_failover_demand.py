@@ -212,3 +212,26 @@ def test_elastic_membership_remove_and_add_active():
         assert ack and ack["ok"], ack
     finally:
         c.close()
+
+
+def test_election_fires_for_alive_nonmember_coordinator():
+    """Chaos-soak find (seed 20260730): elastic membership can leave a
+    group whose ballot coordinator is ALIVE but no longer a member — it
+    will never serve the group, yet no election fired because the node
+    still answered pings.  A non-member coordinator must count as dead
+    (long-dead included, so any member may run)."""
+    import numpy as np
+
+    from gigapaxos_tpu.failure_detection import FailureDetector
+    from gigapaxos_tpu.ops.ballot import encode_ballot
+
+    bal = np.array([int(encode_ballot(5, 2))])  # coordinator = node 2
+    mask = np.array([0b011])                    # members {0, 1} only
+    for me, expect in ((0, True), (1, True), (2, False)):
+        fd = FailureDetector(me, [0, 1, 2])     # everyone recently heard
+        want = fd.want_coord(bal, mask, 3)
+        assert bool(want[0]) is expect, (me, want)
+    # sanity: a MEMBER coordinator that is up triggers nothing
+    bal_ok = np.array([int(encode_ballot(5, 1))])
+    fd = FailureDetector(0, [0, 1, 2])
+    assert not fd.want_coord(bal_ok, mask, 3).any()

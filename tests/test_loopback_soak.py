@@ -1,0 +1,106 @@
+"""Loopback soak parity (ref: ``tests/loopback_1_group/
+testing.properties:1-9`` — 10,000 requests at 1,000 req/s over 1 group x
+3 replicas on 127.0.0.1, and the ``loopback_10_groups`` variant): fixed-
+load soaks against the DEPLOYABLE node path (sockets + client), asserting
+the reference probe's >= 90% response-rate bar.  These are the regression
+numbers for the request-coalescing path — before batching, one group
+topped out near K/tick ~ 800 req/s and this soak could not pass."""
+
+import threading
+import time
+
+import pytest
+
+from gigapaxos_tpu.clients.reconfigurable_client import ReconfigurableAppClient
+from gigapaxos_tpu.models.apps import NoopPaxosApp
+from gigapaxos_tpu.ops.engine import EngineConfig
+from gigapaxos_tpu.reconfigurable_node import ReconfigurableNode
+from gigapaxos_tpu.testing.ports import free_ports
+from gigapaxos_tpu.utils.config import Config
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ports = free_ports(6)
+    Config.clear()
+    for i in range(3):
+        Config.set(f"active.AR{i}", f"127.0.0.1:{ports[i]}")
+        Config.set(f"reconfigurator.RC{i}", f"127.0.0.1:{ports[3 + i]}")
+    ar_cfg = EngineConfig(n_groups=32, window=16, req_lanes=8, n_replicas=3)
+    rc_cfg = EngineConfig(n_groups=8, window=8, req_lanes=4, n_replicas=3)
+    nodes = [
+        ReconfigurableNode(f"AR{i}", NoopPaxosApp, ar_cfg=ar_cfg,
+                           rc_cfg=rc_cfg)
+        for i in range(3)
+    ] + [
+        ReconfigurableNode(f"RC{i}", NoopPaxosApp, ar_cfg=ar_cfg,
+                           rc_cfg=rc_cfg)
+        for i in range(3)
+    ]
+    for n in nodes:
+        n.start()
+    client = ReconfigurableAppClient.from_properties()
+    yield nodes, client
+    client.close()
+    for n in nodes:
+        n.stop()
+    Config.clear()
+
+
+def soak(client, names, n_requests, rate, latency_grace_s=2.0):
+    """Fire `n_requests` at `rate`/s round-robin over `names`; returns
+    (response_rate, mean_latency_s)."""
+    lock = threading.Lock()
+    lats = []
+
+    def cb_factory(t0):
+        def cb(rid, resp, error):
+            if not error:
+                with lock:
+                    lats.append(time.time() - t0)
+        return cb
+
+    interval = 1.0 / rate
+    next_t = time.time()
+    for i in range(n_requests):
+        now = time.time()
+        while now < next_t:
+            time.sleep(min(interval, next_t - now))
+            now = time.time()
+        next_t += interval
+        client.send_request(
+            names[i % len(names)], f"s{i}", cb_factory(time.time())
+        )
+    time.sleep(latency_grace_s)
+    with lock:
+        n_ok = len(lats)
+        mean = sum(lats) / n_ok if n_ok else float("inf")
+    return n_ok / n_requests, mean
+
+
+@pytest.mark.timeout(180)
+def test_loopback_1_group_soak(cluster):
+    """1 group x 3 replicas, 10k requests @ 1k/s (the reference's
+    loopback_1_group config), >= 90% answered."""
+    _nodes, client = cluster
+    ack = client.create_name("soak1", actives=[0, 1, 2], timeout=30)
+    assert ack and ack.get("ok"), ack
+    assert client.send_request_sync("soak1", "warm", timeout=15) is not None
+    resp_rate, mean_lat = soak(client, ["soak1"], 10_000, 1_000.0)
+    assert resp_rate >= 0.90, (resp_rate, mean_lat)
+    assert mean_lat < 2.0, mean_lat
+
+
+@pytest.mark.timeout(180)
+def test_loopback_10_groups_soak(cluster):
+    """10 groups variant (loopback_10_groups): the same load spread over
+    10 names, >= 90% answered."""
+    _nodes, client = cluster
+    names = [f"soak10_{i}" for i in range(10)]
+    for nm in names:
+        ack = client.create_name(nm, actives=[0, 1, 2], timeout=30)
+        assert ack and ack.get("ok"), ack
+        assert client.send_request_sync(nm, "warm", timeout=15) is not None
+    resp_rate, mean_lat = soak(client, names, 10_000, 1_000.0)
+    assert resp_rate >= 0.90, (resp_rate, mean_lat)
+    assert mean_lat < 2.0, mean_lat
