@@ -86,6 +86,61 @@ class DemandProfile(AbstractDemandProfile):
         self.by_active.clear()
 
 
+class ProximityDemandProfile(DemandProfile):
+    """Locality-driven migration — the GeoIP demand profile analog (the
+    reference fork's ``GeoIpDemandProfile.java:1-80`` reconfigures a
+    name toward the active nearest its dominant client IPs).
+
+    TPU-native formulation without an IP database: clients already pick
+    their NEAREST active via latency-aware redirection
+    (:class:`~gigapaxos_tpu.net.rtt.LatencyAwareRedirector`), so the
+    per-entry request counts the actives report ARE a client-locality
+    signal.  When one entry active sources a dominant share of a name's
+    traffic, the profile proposes a replica set drawn from that active's
+    REGION — configured as ``REGION.<active_id>=zone`` properties (the
+    deployment analog of the GeoIP database).  Without a region map it
+    only measures, like the default profile."""
+
+    MIN_REQUESTS = 128   # don't migrate on noise
+    DOMINANCE = 0.5      # hot entry must source at least this share
+    DECAY_AT = 4096      # halve history past this: locality must track
+    #                      SHIFTED traffic in bounded time, not lifetime sums
+
+    def combine(self, report: Dict) -> None:
+        super().combine(report)
+        if sum(self.by_active.values()) >= self.DECAY_AT:
+            self.by_active = {
+                a: n // 2 for a, n in self.by_active.items() if n >= 2
+            }
+
+    def reconfigure(self, cur_actives, all_actives):
+        total = sum(self.by_active.values())
+        if total < self.MIN_REQUESTS:
+            return None
+        hot, n = max(self.by_active.items(), key=lambda kv: kv[1])
+        if hot not in all_actives:
+            # a removed active's stale history must not block locality
+            # decisions for the survivors forever
+            del self.by_active[hot]
+            return None
+        if n < total * self.DOMINANCE:
+            return None
+        region = Config.get(f"REGION.{hot}")
+        if region is None:
+            return None  # no region map configured: measure only
+        target = [hot] + [
+            a for a in all_actives
+            if a != hot and Config.get(f"REGION.{a}") == region
+        ][: max(0, len(cur_actives) - 1)]
+        # top up with current members when the region is smaller than
+        # the replica count (availability beats strict locality)
+        target += [a for a in cur_actives if a not in target]
+        target = target[: len(cur_actives)]
+        if sorted(target) == sorted(cur_actives):
+            return None
+        return target
+
+
 class AggregateDemandProfiler:
     """Per-name profile table with clipping
     (``AggregateDemandProfiler.java`` analog)."""

@@ -235,3 +235,51 @@ def test_election_fires_for_alive_nonmember_coordinator():
     bal_ok = np.array([int(encode_ballot(5, 1))])
     fd = FailureDetector(0, [0, 1, 2])
     assert not fd.want_coord(bal_ok, mask, 3).any()
+
+
+def test_proximity_profile_migrates_toward_demand_region():
+    """GeoIP-profile analog: with a REGION map configured and one entry
+    active sourcing the dominant traffic share, the name migrates onto
+    that active's region (ref: the fork's GeoIpDemandProfile.java:1-80)."""
+    from gigapaxos_tpu.reconfiguration.demand import ProximityDemandProfile
+    from gigapaxos_tpu.utils.config import Config
+
+    Config.set("REGION.0", "east")
+    Config.set("REGION.1", "east")
+    Config.set("REGION.2", "west")
+    Config.set("REGION.3", "west")
+    try:
+        c = make_cluster(demand_profile_cls=ProximityDemandProfile)
+        try:
+            for ar in c.active_replicas:
+                ar.demand_report_period_s = 0.05
+            # hosted mostly in the WEST, but all traffic enters via 0 (east)
+            create(c, "geo", [0, 2, 3])
+            deadline = time.time() + 40
+            rec = None
+            i = 0
+            while time.time() < deadline:
+                i += 1
+                c.ars.managers[0].propose("geo", f"v{i}")
+                c.step()
+                rec = c.reconfigurators[0].rc_app.get_record("geo")
+                if rec.state is RCState.READY and \
+                        sorted(rec.actives) == [0, 1, 2]:
+                    break
+            # east region only has 2 actives; the top-up keeps size 3
+            assert rec is not None and rec.epoch >= 1, rec and rec.to_json()
+            assert 1 in rec.actives and 0 in rec.actives, rec.to_json()
+            assert rec.actives[0] == 0  # anchored at the hot entry
+        finally:
+            c.close()
+    finally:
+        Config.clear()
+
+
+def test_proximity_profile_measures_only_without_region_map():
+    from gigapaxos_tpu.reconfiguration.demand import ProximityDemandProfile
+
+    p = ProximityDemandProfile("x")
+    for _ in range(10):
+        p.combine({"count": 100, "from": 0})
+    assert p.reconfigure([0, 1, 2], [0, 1, 2, 3]) is None
