@@ -165,12 +165,12 @@ def main() -> None:
     on_cpu = platform.startswith("cpu")
     G = int(os.environ.get("BENCH_G", 8_192 if on_cpu else 1_048_576))
     # steady-state commits/group/step reach the K ceiling only when the
-    # ring covers the full in-flight pipeline (W >= 4K measured); W=16/K=8
-    # runs at 5.33 commits/group/step vs W=8/K=4's 2.67, but the step cost
-    # grows with W — on CPU that trade loses, on the chip the data moves
-    # at HBM speed and the deeper pipeline wins
-    W = int(os.environ.get("BENCH_W", 8 if on_cpu else 16))
-    K = int(os.environ.get("BENCH_K", 4 if on_cpu else 8))
+    # ring covers the full in-flight pipeline; the step cost grows with W,
+    # so on CPU shallow wins.  On the chip the r4 sweep at G=1M measured
+    # W16/K8 80.1M, W32/K16 84.2M, W16/K16 75.7M, W32/K8 65.3M dec/s;
+    # W64/K32 and G=2M OOM — W=32/K=16 is the headline shape.
+    W = int(os.environ.get("BENCH_W", 8 if on_cpu else 32))
+    K = int(os.environ.get("BENCH_K", 4 if on_cpu else 16))
     R = 3
     cfg = EngineConfig(n_groups=G, window=W, req_lanes=K, n_replicas=R)
     states = build_replica_states(cfg)

@@ -132,9 +132,14 @@ class ProximityDemandProfile(DemandProfile):
             a for a in all_actives
             if a != hot and Config.get(f"REGION.{a}") == region
         ][: max(0, len(cur_actives) - 1)]
-        # top up with current members when the region is smaller than
-        # the replica count (availability beats strict locality)
-        target += [a for a in cur_actives if a not in target]
+        # top up with current LIVE members when the region is smaller
+        # than the replica count (availability beats strict locality; a
+        # member already removed from the cluster adds none and would
+        # make the whole proposal fail the caller's liveness check)
+        target += [
+            a for a in cur_actives
+            if a not in target and a in all_actives
+        ]
         target = target[: len(cur_actives)]
         if sorted(target) == sorted(cur_actives):
             return None
