@@ -132,15 +132,18 @@ class ProximityDemandProfile(DemandProfile):
             a for a in all_actives
             if a != hot and Config.get(f"REGION.{a}") == region
         ][: max(0, len(cur_actives) - 1)]
-        # top up with current LIVE members when the region is smaller
-        # than the replica count (availability beats strict locality; a
-        # member already removed from the cluster adds none and would
-        # make the whole proposal fail the caller's liveness check)
+        # top up to the full replica count when the region is smaller:
+        # surviving current members first, then any other live active
+        # (availability beats strict locality; dead members add none, and
+        # a locality move must NEVER shrink the set)
         target += [
             a for a in cur_actives
             if a not in target and a in all_actives
         ]
+        target += [a for a in all_actives if a not in target]
         target = target[: len(cur_actives)]
+        if len(target) < len(cur_actives):
+            return None  # cluster too small to keep the replica count
         if sorted(target) == sorted(cur_actives):
             return None
         return target
