@@ -962,12 +962,19 @@ class PaxosManager:
                 if cur_ver > epoch:
                     return False
                 if cur_ver == epoch:
-                    if int(row) == cur:
+                    hosted = self.get_replica_group(name)
+                    if int(row) == cur and hosted == sorted(
+                        int(m) for m in members
+                    ):
                         if not pending and cur in self.pending_rows:
                             self._unpend_locked(cur)
                         return True
-                    # live re-home: snapshot (with window remnants), free
-                    # the old row, fall through to restore at the new one
+                    # live re-home (new row) OR membership heal (same row,
+                    # STALE member set — the record's actives are
+                    # authoritative post-COMPLETE; a member keeping a
+                    # divergent mask would ignore the true members' blobs
+                    # forever): snapshot with window remnants, free the
+                    # row, fall through to restore with the new set
                     if self.pause_group(name, epoch, force=True) != "ok":
                         return False
             rec = self.paused.pop((name, epoch), None)

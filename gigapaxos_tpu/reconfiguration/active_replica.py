@@ -291,7 +291,14 @@ class ActiveReplica:
             })
             return
         hosted_row = self.coordinator.epoch_row_of(name, epoch)
-        if cur == epoch and (row is None or hosted_row == int(row)):
+        want_actives = body.get("actives")
+        members = self.coordinator.get_replica_group(name)
+        members_ok = (
+            want_actives is None or members is None
+            or sorted(members) == sorted(want_actives)
+        )
+        if cur == epoch and (row is None or hosted_row == int(row)) \
+                and members_ok:
             self.coordinator.commit_replica_group(name, epoch, row)
             self.send(tuple(body["rc"]), "ack_epoch_commit", {
                 "name": name, "epoch": epoch, "from": self.my_id,

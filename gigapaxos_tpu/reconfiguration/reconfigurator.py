@@ -234,9 +234,13 @@ class EpochCommitTask(ThresholdProtocolTask):
     def send_to(self, node):
         # the winning row rides along: a laggard still holding a LOSING
         # row for this epoch must NOT un-pend it (the losing row may alias
-        # another group on its peers) — it waits for the late-start
+        # another group on its peers) — it waits for the late-start.
+        # The actives list rides too: a member at the right (epoch, row)
+        # but with a STALE member set would otherwise ack ok and keep
+        # ignoring the true members' blobs forever (mask split-brain)
         return (("AR", node), "epoch_commit", {
             "name": self.name, "epoch": self.epoch, "row": self.row,
+            "actives": sorted(self.nodes),
             "rc": ["RC", self.rcf.my_id],
         })
 
