@@ -360,6 +360,16 @@ class PaxosManager:
         # horizons — after enough blocked ticks the state pull fires
         # regardless of gap size
         self._payload_blocked: Dict[int, Tuple[int, int]] = {}
+        # rows whose DEVICE frontier has sat strictly behind the majority
+        # frontier without progress: if the decisions they need left every
+        # peer's window (majority paused + resumed at a higher frontier),
+        # no gap is small enough to heal through the rings — after enough
+        # stalled ticks the row both fires a state pull and ACCEPTS a
+        # small-gap jump (chaos find).  Vectorized (arm tick / armed
+        # slot per row; -1 = disarmed): during a mass catch-up every
+        # lagging row updates each tick, which a Python dict cannot afford
+        self._stall_since = np.full(G, -1, np.int64)
+        self._stall_slot = np.full(G, -1, np.int64)
         # rows that joined an epoch > 0 WITHOUT state (membership heal /
         # resume fallback): their logical app state is the previous
         # epoch's final state, which no frontier counter reflects — with
@@ -706,6 +716,7 @@ class PaxosManager:
                 # executing them after the restore would double-apply them.
                 self.pending_exec.pop(cur_row, None)
                 self._payload_blocked.pop(cur_row, None)
+                self._stall_since[cur_row] = -1
                 self._needs_state.discard(cur_row)
                 self.app_exec_slot[cur_row] = int(
                     self._np("exec_slot")[cur_row]
@@ -813,6 +824,7 @@ class PaxosManager:
         del self.row_name[row]
         self.pending_rows.discard(row)
         self._payload_blocked.pop(row, None)
+        self._stall_since[row] = -1
         self._needs_state.discard(row)
         self.state = kill_groups(self.state, np.array([row]))
         if self.logger:
@@ -858,6 +870,7 @@ class PaxosManager:
             del self.row_name[row]
             self.pending_rows.discard(row)
             self._payload_blocked.pop(row, None)
+            self._stall_since[row] = -1
             self._needs_state.discard(row)
             self.state = kill_groups(self.state, np.array([row]))
             if self.logger:
@@ -1977,6 +1990,7 @@ class PaxosManager:
     # ------------------------------------------------------------------
     STATE_REQ_INTERVAL = 16  # ticks between pulls for the same row
     PAYLOAD_BLOCKED_TICKS = 64  # parked-on-missing-payload pull trigger
+    FRONTIER_STALLED_TICKS = 64  # behind-majority-without-progress trigger
 
     def _maybe_request_state(self, out_np) -> None:
         """Detect rows needing a state pull: (a) device frontier stranded
@@ -1988,7 +2002,11 @@ class PaxosManager:
         or (c) the cursor parked on a missing payload for many ticks at
         ANY gap size — a short-history group whose payloads were GC'd
         before this member joined fits under both horizons yet can never
-        execute its way forward."""
+        execute its way forward, or (d) the device frontier strictly
+        behind the majority with NO progress for many ticks at ANY gap —
+        the needed decisions can leave every peer's window entirely (a
+        majority that paused+resumed keeps only >= frontier remnants),
+        and a row in this state must heal by a (small-gap) jump."""
         W = self.cfg.window
         exec_np = self._np("exec_slot")
         behind_dev = (out_np.maj_exec - exec_np) > W
@@ -1997,6 +2015,18 @@ class PaxosManager:
         for g, (t0, _slot) in self._payload_blocked.items():
             if self._tick_no - t0 > self.PAYLOAD_BLOCKED_TICKS:
                 need[g] = True
+        # (d) frontier-stalled tracking, vectorized: (re)arm whenever the
+        # stalled SLOT changes; rows making progress or caught up disarm
+        behind = out_np.maj_exec > exec_np
+        rearm = behind & (self._stall_slot != exec_np)
+        self._stall_since = np.where(
+            rearm, self._tick_no, np.where(behind, self._stall_since, -1)
+        )
+        self._stall_slot = np.where(behind, exec_np, -1)
+        need |= (
+            behind & (self._stall_since >= 0)
+            & (self._tick_no - self._stall_since > self.FRONTIER_STALLED_TICKS)
+        )
         for g in self._needs_state:
             need[g] = True
         if not need.any():
@@ -2126,11 +2156,20 @@ class PaxosManager:
                 continue
             donor_exec = int(ent["exec"])
             my_exec = int(exec_np[g])
-            if donor_exec >= my_exec + W:
-                # only jump clear past my whole ring — anything nearer can
-                # (and must) be learned through the normal gather path, and
-                # the jump may then safely forget my in-window accepted
-                # values (all below the donor frontier, decided, obsolete)
+            stalled = (
+                int(self._stall_since[g]) >= 0
+                and self._tick_no - int(self._stall_since[g])
+                > self.FRONTIER_STALLED_TICKS
+                and int(self._stall_slot[g]) == my_exec
+            )
+            if donor_exec >= my_exec + W or (
+                stalled and donor_exec > my_exec
+            ):
+                # jump clear past my ring, OR any positive gap once the
+                # frontier has provably stalled (the needed decisions
+                # left every peer's window — rings can't heal it).  Safe
+                # at any gap: jump_rows keeps window lanes at/above the
+                # adopted frontier, so no live vote is forgotten
                 jumps.append(ent)
             elif donor_exec <= my_exec and (
                 donor_exec > int(self.app_exec_slot[g])
@@ -2162,6 +2201,7 @@ class PaxosManager:
             self._app_exec_dirty.add(g)
             self.pending_exec.pop(g, None)
             self._payload_blocked.pop(g, None)
+            self._stall_since[g] = -1
             self._needs_state.discard(g)
             if int(ent["stopped"]) and self.on_stop_executed is not None:
                 # the STOP decision will never execute locally (the jump
@@ -2179,6 +2219,7 @@ class PaxosManager:
             self.app_exec_slot[g] = int(ent["exec"])
             self._app_exec_dirty.add(g)
             self._payload_blocked.pop(g, None)
+            self._stall_since[g] = -1
             self._needs_state.discard(g)
             pend = self.pending_exec.get(g)
             if pend:  # decisions at/past the adopted cursor still execute

@@ -102,6 +102,13 @@ class HashChainApp(Replicable):
             self.n_executed.pop(name, None)
             return True
         d = json.loads(state)
+        if not d["h"]:
+            # an untouched chain's checkpoint: normalize to ABSENT so a
+            # member that restored it and one that never touched the name
+            # compare equal (the RSM checks compare state.get(name))
+            self.state.pop(name, None)
+            self.n_executed.pop(name, None)
+            return True
         self.state[name] = d["h"]
         self.n_executed[name] = d["n"]
         return True

@@ -120,23 +120,35 @@ def jump_rows(
 ) -> EngineState:
     """Checkpoint-transfer jump (``PaxosAcceptor.jumpSlot``,
     ``PaxosAcceptor.java:538`` / ``handleCheckpoint``,
-    ``PaxosInstanceStateMachine.java:1744``): a straggler whose needed
-    decisions left every peer's ring adopts a donor's frontier wholesale.
-    All windows clear — everything below the new frontier is decided and
-    obsolete, and the caller guarantees ``exec_slot >= old frontier + W``
-    so no live accepted value of this replica is forgotten."""
+    ``PaxosInstanceStateMachine.java:1744``): a straggler adopts a
+    donor's frontier.  Window lanes clear only BELOW the new frontier
+    (those slots are decided and obsolete); lanes at/above it keep —
+    they may hold this replica's live accepted votes, and forgetting a
+    vote could double-vote a slot.  The partial clear makes the jump
+    safe at ANY gap size, not only past the whole ring (the small-gap
+    case matters: a member stranded one slot behind a majority that
+    paused+resumed can ONLY heal by jumping — the decisions it needs
+    left every ring; chaos-soak find)."""
     idx = jnp.asarray(idx, jnp.int32)
     n = idx.shape[0]
     W = state.acc_bal.shape[1]
     nullw = jnp.full((n, W), NULL, jnp.int32)
+    new_exec = jnp.asarray(exec_slot, jnp.int32)
+    acc_keep = (state.acc_slot[idx] != NULL) & (
+        state.acc_slot[idx] >= new_exec[:, None]
+    )
+    dec_keep = (state.dec_slot[idx] != NULL) & (
+        state.dec_slot[idx] >= new_exec[:, None]
+    )
+    keepw = lambda keep, leaf: jnp.where(keep, leaf[idx], nullw)
     return state._replace(
         bal=state.bal.at[idx].set(jnp.maximum(state.bal[idx], jnp.asarray(bal, jnp.int32))),
-        exec_slot=state.exec_slot.at[idx].set(jnp.asarray(exec_slot, jnp.int32)),
-        acc_bal=state.acc_bal.at[idx].set(nullw),
-        acc_vid=state.acc_vid.at[idx].set(nullw),
-        acc_slot=state.acc_slot.at[idx].set(nullw),
-        dec_vid=state.dec_vid.at[idx].set(nullw),
-        dec_slot=state.dec_slot.at[idx].set(nullw),
+        exec_slot=state.exec_slot.at[idx].set(new_exec),
+        acc_bal=state.acc_bal.at[idx].set(keepw(acc_keep, state.acc_bal)),
+        acc_vid=state.acc_vid.at[idx].set(keepw(acc_keep, state.acc_vid)),
+        acc_slot=state.acc_slot.at[idx].set(keepw(acc_keep, state.acc_slot)),
+        dec_vid=state.dec_vid.at[idx].set(keepw(dec_keep, state.dec_vid)),
+        dec_slot=state.dec_slot.at[idx].set(keepw(dec_keep, state.dec_slot)),
         app_hash=state.app_hash.at[idx].set(jnp.asarray(app_hash, jnp.int32)),
         n_execd=state.n_execd.at[idx].set(jnp.asarray(n_execd, jnp.int32)),
         stopped=state.stopped.at[idx].set(jnp.asarray(stopped, jnp.int32)),
