@@ -153,8 +153,26 @@ def test_chaos_soak(seed, monkeypatch):
                         if (nm, rec.epoch) in m.paused]
                 assert held, (nm, "paused with no pause records anywhere")
                 continue
-            # READY: actives host the name at ONE aligned row and agree
-            rows = {c.ars.managers[a].names.get(nm) for a in rec.actives}
+            # READY: actives host the name at ONE aligned row and agree.
+            # POLLED: a member that missed its start is healed by the
+            # commit round's re-drive (wall-timer based), which may still
+            # be in flight the instant the record itself reads READY.
+            # The record is re-read each iteration: the 60s deactivation
+            # sweep can legitimately pause a name mid-poll.
+            rows = set()
+            for _ in range(600):
+                rec = c.reconfigurators[0].rc_app.get_record(nm)
+                if rec is None or rec.deleted or \
+                        rec.state is not RCState.READY:
+                    break  # paused/deleted mid-poll: nothing to align
+                rows = {c.ars.managers[a].names.get(nm) for a in rec.actives}
+                if rows == {rec.row}:
+                    break
+                c.step()
+            else:
+                rows = {c.ars.managers[a].names.get(nm) for a in rec.actives}
+            if rec is None or rec.deleted or rec.state is not RCState.READY:
+                continue
             assert rows == {rec.row}, (nm, rec.row, rows)
             # a laggard may still be catching up through payload pulls or
             # a checkpoint jump — poll until the RSM states converge (a
