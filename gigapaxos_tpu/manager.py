@@ -1058,6 +1058,21 @@ class PaxosManager:
             self.row_activity[r] = time.time()
             return True
 
+    def pause_record_keys(self) -> List[Tuple[str, int]]:
+        """(name, epoch) of every locally held pause record (the AR layer
+        probes the RC about them: a record the RC no longer knows is
+        droppable; a record whose epoch is LIVE means an aborted pause
+        round left this member frozen and it must rejoin)."""
+        with self._state_lock:
+            return [(str(n), int(e)) for (n, e) in self.paused]
+
+    def drop_pause_record(self, name: str, epoch: int) -> None:
+        with self._state_lock:
+            try:
+                del self.paused[(name, int(epoch))]
+            except KeyError:
+                pass
+
     def dedup_for_name(self, name: str) -> Dict[str, list]:
         """This name's exactly-once entries, for shipping WITH any app
         -state handoff (epoch final state, pause record, state transfer):

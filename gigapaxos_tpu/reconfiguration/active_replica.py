@@ -98,6 +98,8 @@ class ActiveReplica:
 
         self.pause_option = Config.get_bool(PC.PAUSE_OPTION)
         self.deactivation_period_s = Config.get_float(PC.DEACTIVATION_PERIOD_S)
+        # (name, epoch) -> (next probe time, current interval)
+        self._probe_backoff: Dict[Tuple[str, int], Tuple[float, float]] = {}
         from .rc_config import RC
 
         self.demand_report_period_s = Config.get_float(
@@ -146,6 +148,12 @@ class ActiveReplica:
             self._handle_epoch_commit(body)
         elif kind == "pause_epoch":
             self._handle_pause_epoch(body)
+        elif kind == "pause_drop":
+            # RC says this pause record is obsolete (name deleted or the
+            # epoch moved past it): GC it
+            self.coordinator.drop_pause_record(
+                body["name"], int(body["epoch"])
+            )
 
     def tick(self, now: Optional[float] = None) -> None:
         self.tasks.tick(now)
@@ -179,13 +187,40 @@ class ActiveReplica:
 
     # ---- Deactivator sweep (PaxosManager.java:2931,2786) ---------------
     def _maybe_sweep(self, now: Optional[float] = None) -> None:
-        if not self.rc_ids or not self.pause_option:
+        if not self.rc_ids:
             return
         now = time.time() if now is None else now
         period = self.deactivation_period_s
         if now - self._last_sweep < period:
             return
         self._last_sweep = now
+        # probe held pause records (chaos find: an aborted pause round
+        # leaves this member FROZEN while the record stays live — if it
+        # is the group's ballot coordinator, the whole group wedges; the
+        # RC answers with a committed resume, silence, or a drop).
+        # NOT gated by pause_option: records can predate a config change,
+        # and healing them is unrelated to whether we SUGGEST new pauses.
+        # Per-record EXPONENTIAL BACKOFF (up to 16 periods): long-paused
+        # groups are the normal steady state at residency scale, and
+        # re-asking about each of them every period would cost
+        # O(paused * members) control traffic forever.
+        keys = set(self.coordinator.pause_record_keys())
+        for k in [k for k in self._probe_backoff if k not in keys]:
+            del self._probe_backoff[k]
+        for name, epoch in keys:
+            ent = self._probe_backoff.get((name, epoch))
+            if ent is not None and ent[0] > now:
+                continue
+            interval = min(
+                (ent[1] * 2) if ent else period, period * 16
+            )
+            self._probe_backoff[(name, epoch)] = (now + interval, interval)
+            rc = self.rc_ids[hash(name) % len(self.rc_ids)]
+            self.send(("RC", rc), "pause_probe", {
+                "name": name, "epoch": int(epoch), "from": self.my_id,
+            })
+        if not self.pause_option:
+            return
         for name, epoch in self.coordinator.idle_groups(period):
             rc = self.rc_ids[hash(name) % len(self.rc_ids)]
             self.send(("RC", rc), "suggest_pause", {

@@ -79,6 +79,13 @@ class AbstractReplicaCoordinator:
         """(name, epoch) pairs idle long enough for a Deactivator sweep."""
         raise NotImplementedError
 
+    def pause_record_keys(self):
+        """(name, epoch) of locally held pause records (probe targets)."""
+        return []
+
+    def drop_pause_record(self, name: str, epoch: int) -> None:
+        """Discard a pause record the RC says is obsolete."""
+
     def drain_demand(self):
         """{name: (request count since last drain, epoch)} for demand
         reporting (updateDemandStats analog)."""
@@ -184,6 +191,12 @@ class PaxosReplicaCoordinator(AbstractReplicaCoordinator):
 
     def idle_groups(self, idle_s: float):
         return self.manager.idle_names(idle_s)
+
+    def pause_record_keys(self):
+        return self.manager.pause_record_keys()
+
+    def drop_pause_record(self, name: str, epoch: int) -> None:
+        self.manager.drop_pause_record(name, epoch)
 
     def drain_demand(self):
         return self.manager.drain_demand()

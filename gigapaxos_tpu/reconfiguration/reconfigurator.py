@@ -265,20 +265,10 @@ class EpochCommitTask(ThresholdProtocolTask):
                 # epoch survives but the row moves — this round's heal
                 # would resume the member back onto the OBSOLETE row
                 return None
-            # RESUME semantics heal every missing shape uniformly: a
-            # losing pending row re-homes with its held queue, a pause
-            # record restores, and a member with no state joins empty
-            # (resume_group's fallback) and heals via state transfer.
-            self.rcf.send(("AR", int(body["from"])), "start_epoch", {
-                "name": self.name, "epoch": self.epoch,
-                "actives": list(self.nodes), "row": self.row,
-                "initial_state": (
-                    self.initial_state if self.epoch == 0 else None
-                ),
-                "prev_actives": [], "prev_epoch": -1,
-                "resume": True, "committed": True,
-                "rc": ["RC", self.rcf.my_id],
-            })
+            self.rcf.send_committed_resume(
+                int(body["from"]), self.name, self.epoch,
+                list(self.nodes), self.row, self.initial_state,
+            )
             return None  # the retransmitted commit confirms after the join
         return int(body["from"])
 
@@ -524,6 +514,8 @@ class Reconfigurator:
             self.tasks.handle_event(f"pause:{body['name']}", kind, body)
         elif kind == "suggest_pause":
             self._handle_suggest_pause(body)
+        elif kind == "pause_probe":
+            self._handle_pause_probe(body)
         elif kind == "reactivate_service":
             self.kick_reactivate(body["name"])
         elif kind == "demand_report":
@@ -844,6 +836,69 @@ class Reconfigurator:
             "new_actives": list(target),
             "new_row": row_for(name, rec.epoch + 1, 0, self.n_groups),
         })
+
+    def send_committed_resume(
+        self, dst_ar: int, name: str, epoch: int, actives: List[int],
+        row: int, initial_state: Optional[str] = None,
+    ) -> None:
+        """The uniform missing-member heal (shared by the epoch-commit
+        NACK branch and the pause probe): a committed RESUME start — a
+        losing pending row re-homes with its held queue, a pause record
+        restores, and a member with no state joins empty and heals via
+        state transfer."""
+        self.send(("AR", dst_ar), "start_epoch", {
+            "name": name, "epoch": epoch,
+            "actives": list(actives), "row": row,
+            "initial_state": initial_state if epoch == 0 else None,
+            "prev_actives": [], "prev_epoch": -1,
+            "resume": True, "committed": True,
+            "rc": ["RC", self.my_id],
+        })
+
+    def _handle_pause_probe(self, body: Dict) -> None:
+        """A member holding a local pause record for (name, epoch) asks
+        what to do with it (chaos-soak find: a pause round that aborted
+        after SOME members froze leaves them holding pause records while
+        the record stays live — a frozen ballot coordinator wedges its
+        whole group, and nothing else ever heals it because the node
+        still answers pings and remains in the member mask).
+
+        Answers: committed resume (record live at this epoch and the
+        prober is a member — rejoin in place), pause_drop (name deleted
+        or the epoch superseded — GC the record), or silence (record
+        PAUSED: holding the record is exactly right)."""
+        name, epoch = body["name"], int(body["epoch"])
+        frm = int(body["from"])
+        if not self.is_primary(name):
+            self.send(("RC", self.primary_of(name)), "pause_probe", body)
+            return
+        rec = self.rc_app.get_record(name)
+        if rec is None or rec.deleted or rec.epoch > epoch:
+            self.send(("AR", frm), "pause_drop",
+                      {"name": name, "epoch": epoch})
+            return
+        if rec.epoch != epoch:
+            return  # prober lags the record; other machinery owns it
+        if rec.state not in (RCState.READY, RCState.WAIT_ACK_STOP):
+            # PAUSED/WAIT_PAUSE: holding the record is right.  WAIT_ACK_
+            # START/reactivation: the row is still a PROBE — a committed
+            # resume there would bypass the pending gate and wedge the
+            # row-collision machinery.  WAIT_DELETE: deletion owns it.
+            # READY and WAIT_ACK_STOP both have a SETTLED committed row,
+            # and the frozen member is needed live (under WAIT_ACK_STOP
+            # the stop round cannot commit without it — the original
+            # wedge shape this probe exists for).
+            return
+        if frm not in rec.actives or rec.row < 0:
+            # the live epoch moved on without this member; its snapshot
+            # is superseded by the epoch machinery's state transfer
+            self.send(("AR", frm), "pause_drop",
+                      {"name": name, "epoch": epoch})
+            return
+        # live record, frozen member: rejoin in place
+        self.send_committed_resume(
+            frm, name, rec.epoch, rec.actives, rec.row, rec.initial_state
+        )
 
     # ---- residency (suggest_pause / reactivate) ------------------------
     def _handle_suggest_pause(self, body: Dict) -> None:

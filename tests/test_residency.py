@@ -202,3 +202,85 @@ def test_rc_cluster_restart_mid_migration(tmp_path):
         assert a1.n_executed["mid"] == 4
     finally:
         c2.close()
+
+
+def test_frozen_coordinator_heals_via_pause_probe():
+    """Chaos-soak find: a pause round that aborts after SOME members
+    froze leaves them holding pause records while the RC record stays
+    READY.  A frozen ballot COORDINATOR wedges the whole group (it still
+    answers pings and stays in the member mask, so no election fires).
+    The frozen member's periodic pause-probe must get a committed resume
+    from the RC and rejoin, unwedging consensus."""
+    c = make_cluster()
+    try:
+        # no organic idle-pausing in this test (slow-compile wall time
+        # can exceed the 60s sweep period and pause the group for real;
+        # the healed member's own fast sweep would instantly re-pause it)
+        for ar in c.active_replicas:
+            ar.pause_option = False
+        create(c, "fz")
+        run_requests(c, "fz", ["w1", "w2"])
+        m0 = c.ars.managers[0]
+        row = m0.names["fz"]
+        coord = m0.coordinator_of_row(row)
+        epoch = m0.current_epoch("fz")
+        # simulate the aborted pause round: ONLY the coordinator froze
+        mc = c.ars.managers[coord]
+        assert mc.pause_group("fz", epoch, force=True) == "ok"
+        assert "fz" not in mc.names and ("fz", epoch) in mc.paused
+        # fast probe cadence ONLY on the frozen member (a fast sweep on
+        # the LIVE members would also fire genuine idle-pause suggestions
+        # and pause the whole group mid-test)
+        c.active_replicas[coord].deactivation_period_s = 0.1
+        # traffic from a live member: wedged until the probe heals the
+        # coordinator back in.  RETRANSMITTED like a real client — a
+        # pre-heal forward to the frozen coordinator is consumed there
+        # (not hosting -> dropped), and only the retransmit after the
+        # heal can commit (exactly-once holds via the shared request id)
+        entry = (coord + 1) % 3
+        done = {}
+        rid0 = 1 << 54
+        import time as _t
+
+        deadline = _t.time() + 60
+        last_send = 0.0
+        while _t.time() < deadline and not done:
+            if _t.time() - last_send > 1.0:
+                last_send = _t.time()
+                c.ars.managers[entry].propose(
+                    "fz", "x", request_id=rid0,
+                    callback=lambda rid, r: done.setdefault(rid, r),
+                )
+            c.step()
+        assert done, "frozen-coordinator group never unwedged"
+        assert "fz" in mc.names  # the coordinator rejoined in place
+        assert ("fz", epoch) not in mc.paused
+    finally:
+        c.close()
+
+
+def test_orphaned_pause_record_dropped_by_probe():
+    """A pause record for a DELETED name must be GC'd by the probe
+    instead of lingering forever."""
+    c = make_cluster()
+    try:
+        for ar in c.active_replicas:
+            ar.pause_option = False
+        create(c, "gone")
+        run_requests(c, "gone", ["v"])
+        epoch = c.ars.managers[0].current_epoch("gone")
+        mc = c.ars.managers[1]
+        assert mc.pause_group("gone", epoch, force=True) == "ok"
+        # delete the name while member 1 holds a frozen copy
+        c.client_request("delete_service", {"name": "gone"})
+        ack = c.wait_for("delete_ack", max_steps=400)
+        assert ack and ack.get("ok"), ack
+        c.active_replicas[1].deactivation_period_s = 0.1
+        import time as _t
+
+        deadline = _t.time() + 60
+        while _t.time() < deadline and ("gone", epoch) in mc.paused:
+            c.step()
+        assert ("gone", epoch) not in mc.paused, "orphan record never GC'd"
+    finally:
+        c.close()
