@@ -717,6 +717,7 @@ class PaxosManager:
                 self.pending_exec.pop(cur_row, None)
                 self._payload_blocked.pop(cur_row, None)
                 self._stall_since[cur_row] = -1
+                self._stall_slot[cur_row] = -1
                 self._needs_state.discard(cur_row)
                 self.app_exec_slot[cur_row] = int(
                     self._np("exec_slot")[cur_row]
@@ -749,6 +750,14 @@ class PaxosManager:
         self.app_exec_slot[row] = 0
         self._release_row_queue(row)  # stale leftovers of a prior tenant
         self.pending_exec.pop(row, None)
+        # gossiped peer cursors for this row described its PREVIOUS
+        # tenant (the merge is max-only); keeping them would both pin the
+        # payload-retention watermark wrongly and false-arm the
+        # frontier-stall detector against a frontier that never existed
+        for arr in self.peer_app_exec.values():
+            arr[row] = 0
+        self._stall_since[row] = -1
+        self._stall_slot[row] = -1
         self.row_activity[row] = time.time()
         if held_vids:
             self.queues[row] = held_vids
@@ -825,6 +834,7 @@ class PaxosManager:
         self.pending_rows.discard(row)
         self._payload_blocked.pop(row, None)
         self._stall_since[row] = -1
+        self._stall_slot[row] = -1
         self._needs_state.discard(row)
         self.state = kill_groups(self.state, np.array([row]))
         if self.logger:
@@ -871,6 +881,7 @@ class PaxosManager:
             self.pending_rows.discard(row)
             self._payload_blocked.pop(row, None)
             self._stall_since[row] = -1
+            self._stall_slot[row] = -1
             self._needs_state.discard(row)
             self.state = kill_groups(self.state, np.array([row]))
             if self.logger:
@@ -1362,10 +1373,14 @@ class PaxosManager:
                     arr = np.zeros(self.cfg.n_groups, np.int64)
                     self.peer_app_exec[rid] = arr
                 if isinstance(cursors, dict):  # sparse delta (normal path)
+                    # LAST-writer-wins for rows the sender lists: it is
+                    # authoritative for its own cursor, frames are FIFO
+                    # per peer, and a max-only merge could never LOWER a
+                    # stale value left by a row's previous tenant (which
+                    # would pin the retention watermark wrongly and
+                    # false-arm the frontier-stall detector forever)
                     for row_s, cur in cursors.items():
-                        row = int(row_s)
-                        if cur > arr[row]:
-                            arr[row] = cur
+                        arr[int(row_s)] = cur
                 else:  # dense snapshot (legacy peers)
                     np.maximum(arr, np.asarray(cursors, np.int64), out=arr)
         elif kind == "forward":  # a peer forwards a proposal to me
@@ -2031,8 +2046,22 @@ class PaxosManager:
             if self._tick_no - t0 > self.PAYLOAD_BLOCKED_TICKS:
                 need[g] = True
         # (d) frontier-stalled tracking, vectorized: (re)arm whenever the
-        # stalled SLOT changes; rows making progress or caught up disarm
-        behind = out_np.maj_exec > exec_np
+        # stalled SLOT changes; rows making progress or caught up disarm.
+        # Behind is measured against the MAX known frontier (own device
+        # frontier vs every peer's gossiped app cursor), not the majority
+        # frontier: the chaos soak found the inverted shape too — a
+        # MAJORITY stranded behind one resumed member, where maj_exec
+        # equals the stragglers' own frontier and a majority-based
+        # detector never fires (yet only that one member can donate the
+        # decisions, which left every window).
+        mask_np = self._np("member_mask")
+        peak = np.maximum(
+            exec_np.astype(np.int64), out_np.maj_exec.astype(np.int64)
+        )
+        for r, arr in self.peer_app_exec.items():
+            in_grp = ((mask_np >> r) & 1) == 1
+            peak = np.maximum(peak, np.where(in_grp, arr, 0))
+        behind = peak > exec_np
         rearm = behind & (self._stall_slot != exec_np)
         self._stall_since = np.where(
             rearm, self._tick_no, np.where(behind, self._stall_since, -1)
@@ -2217,6 +2246,7 @@ class PaxosManager:
             self.pending_exec.pop(g, None)
             self._payload_blocked.pop(g, None)
             self._stall_since[g] = -1
+            self._stall_slot[g] = -1
             self._needs_state.discard(g)
             if int(ent["stopped"]) and self.on_stop_executed is not None:
                 # the STOP decision will never execute locally (the jump
@@ -2235,6 +2265,7 @@ class PaxosManager:
             self._app_exec_dirty.add(g)
             self._payload_blocked.pop(g, None)
             self._stall_since[g] = -1
+            self._stall_slot[g] = -1
             self._needs_state.discard(g)
             pend = self.pending_exec.get(g)
             if pend:  # decisions at/past the adopted cursor still execute

@@ -114,7 +114,12 @@ class ManagerCluster:
         # route host-channel traffic over live links for NEXT round
         for i in range(R):
             delta = deltas[i]
-            if delta["arena"]:
+            ae = delta.get("app_exec")
+            if delta["arena"] or (ae and ae[1]):
+                # cursor-only deltas matter too (the deployed server
+                # forwards them the same way): the periodic app-cursor
+                # baseline refresh is how a resumed member's frontier
+                # becomes visible to stranded peers' stall detectors
                 for j in range(R):
                     if j != i and delivery[j, i] == DELIVER:
                         self.inboxes[j].append(("payloads", delta))

@@ -78,3 +78,56 @@ def test_small_gap_straggler_heals_after_majority_resume():
         c.step_all()
     assert len(states) == 1, states
     c.close()
+
+
+def test_majority_behind_single_ahead_member_heals():
+    """The inverted shape (also chaos-found): TWO members blank-rejoin at
+    frontier 0 while ONE resumed member sits at frontier 2 with no
+    below-frontier lanes.  maj_exec equals the stragglers' own frontier,
+    so a majority-based stall detector never fires — the detector must
+    measure against the MAX known frontier (peer app-cursor gossip)."""
+    cfg = EngineConfig(n_groups=8, window=8, req_lanes=4, n_replicas=3)
+    c = ManagerCluster(cfg, HashChainApp)
+    c.create("svc", members=[0, 1, 2])
+    row = c.managers[0].names["svc"]
+
+    done = {}
+    for v in ("x1", "x2"):
+        c.managers[0].propose(
+            "svc", v, callback=lambda r, resp: done.setdefault(r, resp)
+        )
+    for _ in range(40):
+        if len(done) == 2:
+            break
+        c.step_all()
+    assert len(done) == 2
+    epoch = c.managers[0].current_epoch("svc")
+
+    # member 2: pause+resume in place (frontier 2, below-frontier lanes
+    # gone).  members 0 and 1: blank re-join at frontier 0 (the commit-
+    # heal shape) — now the MAJORITY is behind the lone resumed member.
+    assert c.managers[2].pause_group("svc", epoch, force=True) == "ok"
+    assert c.managers[2].resume_group("svc", epoch, [0, 1, 2], row,
+                                      pending=False)
+    for r in (0, 1):
+        m = c.managers[r]
+        assert m.kill("svc")
+        assert m.create_paxos_instance("svc", [0, 1, 2], row=row,
+                                       version=epoch)
+    c.blobs = [m.blob() for m in c.managers]
+
+    import numpy as np
+
+    for _ in range(400):
+        c.step_all()
+        if all(
+            int(np.asarray(m.state.exec_slot)[row]) >= 2 for m in c.managers
+        ) and len({m.app.state.get("svc") for m in c.managers}) == 1:
+            break
+    states = {m.app.state.get("svc") for m in c.managers}
+    assert len(states) == 1 and None not in states, (
+        "majority-behind stragglers never healed",
+        [int(np.asarray(m.state.exec_slot)[row]) for m in c.managers],
+        states,
+    )
+    c.close()
