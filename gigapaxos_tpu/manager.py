@@ -1069,6 +1069,30 @@ class PaxosManager:
             self.row_activity[r] = time.time()
             return True
 
+    def pending_row_keys(self) -> List[Tuple[str, int, int]]:
+        """(name, epoch, row) for every row still behind the pre-COMPLETE
+        admission gate.  Normally transient; a row stuck here after its
+        late-start retransmits expired is wedged (it refuses every
+        proposal) and must ask the RC where the epoch really lives."""
+        with self._state_lock:
+            out = []
+            versions = self._np("version")
+            for row in self.pending_rows:
+                name = self.row_name.get(row)
+                if name is not None and self.names.get(name) == row:
+                    out.append((name, int(versions[row]), int(row)))
+            return out
+
+    def drop_pending_row(self, name: str, epoch: int, row: int) -> None:
+        """RC says this pending row's epoch is gone: free it."""
+        with self._state_lock:
+            cur = self.names.get(name)
+            if cur != int(row) or cur not in self.pending_rows:
+                return
+            if int(self._np("version")[cur]) != int(epoch):
+                return
+            self._kill_locked(name)
+
     def pause_record_keys(self) -> List[Tuple[str, int]]:
         """(name, epoch) of every locally held pause record (the AR layer
         probes the RC about them: a record the RC no longer knows is

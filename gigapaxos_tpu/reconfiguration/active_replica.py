@@ -98,8 +98,9 @@ class ActiveReplica:
 
         self.pause_option = Config.get_bool(PC.PAUSE_OPTION)
         self.deactivation_period_s = Config.get_float(PC.DEACTIVATION_PERIOD_S)
-        # (name, epoch) -> (next probe time, current interval)
-        self._probe_backoff: Dict[Tuple[str, int], Tuple[float, float]] = {}
+        # probe backoff: (name, epoch) for pause records, or
+        # ("pending", name, epoch, row) -> (next probe time, interval)
+        self._probe_backoff: Dict[Tuple, Tuple[float, float]] = {}
         from .rc_config import RC
 
         self.demand_report_period_s = Config.get_float(
@@ -154,6 +155,11 @@ class ActiveReplica:
             self.coordinator.drop_pause_record(
                 body["name"], int(body["epoch"])
             )
+        elif kind == "pending_drop":
+            # RC says this pending row's epoch is gone: free it
+            self.coordinator.drop_pending_row(
+                body["name"], int(body["epoch"]), int(body["row"])
+            )
 
     def tick(self, now: Optional[float] = None) -> None:
         self.tasks.tick(now)
@@ -204,10 +210,14 @@ class ActiveReplica:
         # groups are the normal steady state at residency scale, and
         # re-asking about each of them every period would cost
         # O(paused * members) control traffic forever.
-        keys = set(self.coordinator.pause_record_keys())
-        for k in [k for k in self._probe_backoff if k not in keys]:
+        pause_keys = set(self.coordinator.pause_record_keys())
+        pending_keys = list(self.coordinator.pending_row_keys())
+        live = pause_keys | {
+            ("pending", n, e, r) for n, e, r in pending_keys
+        }
+        for k in [k for k in self._probe_backoff if k not in live]:
             del self._probe_backoff[k]
-        for name, epoch in keys:
+        for name, epoch in pause_keys:
             ent = self._probe_backoff.get((name, epoch))
             if ent is not None and ent[0] > now:
                 continue
@@ -218,6 +228,23 @@ class ActiveReplica:
             rc = self.rc_ids[hash(name) % len(self.rc_ids)]
             self.send(("RC", rc), "pause_probe", {
                 "name": name, "epoch": int(epoch), "from": self.my_id,
+            })
+        # probe rows stuck pre-COMPLETE (same heal family: a member
+        # stranded at a LOSING probe row after its late-start expired
+        # refuses every proposal forever — and the commit round that
+        # would heal it already completed on the others, so nothing
+        # re-drives it)
+        for name, epoch, row in pending_keys:
+            key = ("pending", name, epoch, row)
+            ent = self._probe_backoff.get(key)
+            if ent is not None and ent[0] > now:
+                continue
+            interval = min((ent[1] * 2) if ent else period, period * 16)
+            self._probe_backoff[key] = (now + interval, interval)
+            rc = self.rc_ids[hash(name) % len(self.rc_ids)]
+            self.send(("RC", rc), "pending_probe", {
+                "name": name, "epoch": int(epoch), "row": int(row),
+                "from": self.my_id,
             })
         if not self.pause_option:
             return

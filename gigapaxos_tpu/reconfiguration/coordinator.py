@@ -83,6 +83,13 @@ class AbstractReplicaCoordinator:
         """(name, epoch) of locally held pause records (probe targets)."""
         return []
 
+    def pending_row_keys(self):
+        """(name, epoch, row) of rows stuck pre-COMPLETE (probe targets)."""
+        return []
+
+    def drop_pending_row(self, name: str, epoch: int, row: int) -> None:
+        """Free a pending row whose epoch the RC says is gone."""
+
     def drop_pause_record(self, name: str, epoch: int) -> None:
         """Discard a pause record the RC says is obsolete."""
 
@@ -194,6 +201,12 @@ class PaxosReplicaCoordinator(AbstractReplicaCoordinator):
 
     def pause_record_keys(self):
         return self.manager.pause_record_keys()
+
+    def pending_row_keys(self):
+        return self.manager.pending_row_keys()
+
+    def drop_pending_row(self, name: str, epoch: int, row: int) -> None:
+        self.manager.drop_pending_row(name, epoch, row)
 
     def drop_pause_record(self, name: str, epoch: int) -> None:
         self.manager.drop_pause_record(name, epoch)

@@ -516,6 +516,8 @@ class Reconfigurator:
             self._handle_suggest_pause(body)
         elif kind == "pause_probe":
             self._handle_pause_probe(body)
+        elif kind == "pending_probe":
+            self._handle_pending_probe(body)
         elif kind == "reactivate_service":
             self.kick_reactivate(body["name"])
         elif kind == "demand_report":
@@ -899,6 +901,49 @@ class Reconfigurator:
         self.send_committed_resume(
             frm, name, rec.epoch, rec.actives, rec.row, rec.initial_state
         )
+
+    def _handle_pending_probe(self, body: Dict) -> None:
+        """A member whose row is stuck behind the pre-COMPLETE admission
+        gate asks where the epoch really lives (chaos-soak find: a member
+        stranded at a LOSING probe row after its late-start retransmits
+        expired refuses every proposal forever, and the commit round that
+        would heal it already completed on the other members).
+
+        Answers: a direct epoch_commit re-send when the member's row IS
+        the winning one (its confirm was lost), a committed resume at the
+        winning row when it is stuck at a loser, pending_drop when the
+        epoch is gone, or silence while the start round still owns the
+        row probe."""
+        name, epoch = body["name"], int(body["epoch"])
+        row, frm = int(body["row"]), int(body["from"])
+        if not self.is_primary(name):
+            self.send(("RC", self.primary_of(name)), "pending_probe", body)
+            return
+        rec = self.rc_app.get_record(name)
+        if rec is None or rec.deleted or rec.epoch > epoch:
+            self.send(("AR", frm), "pending_drop",
+                      {"name": name, "epoch": epoch, "row": row})
+            return
+        if rec.epoch != epoch or rec.state not in (
+            RCState.READY, RCState.WAIT_ACK_STOP,
+        ):
+            return  # start round / pause / delete machinery owns it
+        if frm not in rec.actives or rec.row < 0:
+            self.send(("AR", frm), "pending_drop",
+                      {"name": name, "epoch": epoch, "row": row})
+            return
+        if rec.row == row:
+            # the member holds the WINNING row; only its confirm was lost
+            self.send(("AR", frm), "epoch_commit", {
+                "name": name, "epoch": epoch, "row": rec.row,
+                "actives": sorted(rec.actives),
+                "rc": ["RC", self.my_id],
+            })
+        else:
+            self.send_committed_resume(
+                frm, name, rec.epoch, rec.actives, rec.row,
+                rec.initial_state,
+            )
 
     # ---- residency (suggest_pause / reactivate) ------------------------
     def _handle_suggest_pause(self, body: Dict) -> None:

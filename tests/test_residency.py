@@ -284,3 +284,68 @@ def test_orphaned_pause_record_dropped_by_probe():
         assert ("gone", epoch) not in mc.paused, "orphan record never GC'd"
     finally:
         c.close()
+
+
+def test_stranded_pending_row_heals_via_pending_probe():
+    """Chaos-soak find: a member stranded at a LOSING probe row (its
+    late-start retransmits expired) refuses every proposal forever, and
+    the commit round that would heal it already completed on the other
+    members.  The member's pending-row probe must get a committed resume
+    at the winning row."""
+    c = make_cluster()
+    try:
+        for ar in c.active_replicas:
+            ar.pause_option = False
+        create(c, "pr")
+        run_requests(c, "pr", ["a", "b"])
+        rec = c.reconfigurators[0].rc_app.get_record("pr")
+        win_row = rec.row
+        m1 = c.ars.managers[1]
+        # strand member 1 at a losing pending row for the same epoch
+        assert m1.kill("pr")
+        lose_row = (win_row + 5) % 16
+        assert m1.create_paxos_instance(
+            "pr", [0, 1, 2], row=lose_row, version=rec.epoch, pending=True
+        )
+        assert m1.names["pr"] == lose_row and lose_row in m1.pending_rows
+        c.active_replicas[1].deactivation_period_s = 0.1
+        import time as _t
+
+        deadline = _t.time() + 60
+        while _t.time() < deadline and m1.names.get("pr") != win_row:
+            c.step()
+        assert m1.names.get("pr") == win_row, (
+            "pending-row straggler never re-homed",
+            m1.names.get("pr"), win_row,
+        )
+        assert win_row not in m1.pending_rows
+        run_requests(c, "pr", ["c"], entry=1, max_steps=160)
+    finally:
+        c.close()
+
+
+def test_stranded_winning_row_confirm_heals_via_pending_probe():
+    """The sibling shape: the member holds the WINNING row but its
+    epoch_commit confirm was lost and the commit round completed without
+    needing it — the probe re-sends the confirm directly."""
+    c = make_cluster()
+    try:
+        for ar in c.active_replicas:
+            ar.pause_option = False
+        create(c, "pw")
+        run_requests(c, "pw", ["a"])
+        rec = c.reconfigurators[0].rc_app.get_record("pw")
+        m1 = c.ars.managers[1]
+        row = m1.names["pw"]
+        assert row == rec.row
+        # simulate the lost confirm: re-gate the row
+        m1.pending_rows.add(row)
+        c.active_replicas[1].deactivation_period_s = 0.1
+        import time as _t
+
+        deadline = _t.time() + 60
+        while _t.time() < deadline and row in m1.pending_rows:
+            c.step()
+        assert row not in m1.pending_rows, "lost confirm never re-sent"
+    finally:
+        c.close()
