@@ -61,20 +61,27 @@ def single_chip_step(cfg: EngineConfig):
     """vmap-over-replicas step on one device.
 
     Takes (states [R,...], req_vid [R,G,K], want_coord [R,G]) and returns
-    (states', outputs [R,...]).
+    (states', outputs [R,...]).  ``heard`` is an optional [R(recv), R(send)]
+    bool delivery matrix for fault injection (the reference drops a crashed
+    node's traffic in TESTPaxosConfig.crash/isCrashed,
+    ``testing/TESTPaxosConfig.java:563-580``); row i masks which peers'
+    blobs replica i consumes this step.  None (the default) means full
+    delivery.  A replica always hears itself — the diagonal is forced.
     """
     R = cfg.n_replicas
-    heard = jnp.ones((R,), bool)
     my_ids = jnp.arange(R, dtype=jnp.int32)
 
-    def _one(state, gathered, req, want, my_id):
-        return step(state, gathered, heard, req, want, my_id, cfg)
+    def _one(state, gathered, heard_row, req, want, my_id):
+        return step(state, gathered, heard_row, req, want, my_id, cfg)
 
     @jax.jit
-    def run(states, req_vid, want_coord):
+    def run(states, req_vid, want_coord, heard=None):
+        h = jnp.ones((R, R), bool) if heard is None else (
+            jnp.asarray(heard, bool) | jnp.eye(R, dtype=bool)
+        )
         blobs = jax.vmap(make_blob)(states)
-        return jax.vmap(_one, in_axes=(0, None, 0, 0, 0))(
-            states, blobs, req_vid, want_coord, my_ids
+        return jax.vmap(_one, in_axes=(0, None, 0, 0, 0, 0))(
+            states, blobs, h, req_vid, want_coord, my_ids
         )
 
     return run
@@ -84,8 +91,15 @@ def spmd_step(cfg: EngineConfig, mesh: Mesh):
     """shard_map step over the (g, r) mesh.
 
     Global args: states [R, G, ...] with P('r', 'g'); req_vid [R, G, K];
-    want_coord [R, G].  Each shard holds [1, G/gs, ...]; the replica-axis
-    blob exchange is one all_gather per step on ICI.
+    want_coord [R, G]; heard (optional) [R(recv), R(send)] bool delivery
+    matrix, sharded P('r', None) so each replica shard carries its own
+    receive row.  Each shard holds [1, G/gs, ...]; the replica-axis blob
+    exchange is one all_gather per step on ICI.  A dropped peer is a heard
+    row entry set False: the all_gather still runs (the collective is
+    membership-oblivious, like the reference's NIO multicast to a crashed
+    node) and the engine masks the dead peer's blob out of every quorum
+    (ref fault model: ``testing/TESTPaxosConfig.java:563-580``).  The
+    diagonal is forced — a replica always hears itself.
     """
     R = cfg.n_replicas
     rg = P(REPLICA_AXIS, GROUP_AXIS)
@@ -104,24 +118,33 @@ def spmd_step(cfg: EngineConfig, mesh: Mesh):
             state_spec,
             P(REPLICA_AXIS, GROUP_AXIS, None),
             P(REPLICA_AXIS, GROUP_AXIS),
+            P(REPLICA_AXIS, None),
         ),
         out_specs=(state_spec, out_spec),
         check_vma=False,
     )
-    def _sharded(states, req_vid, want_coord):
-        # local shapes: leaves [1, G_loc, ...]
+    def _sharded(states, req_vid, want_coord, heard):
+        # local shapes: leaves [1, G_loc, ...]; heard [1, R]
         state = jax.tree.map(lambda x: x[0], states)
         blob = make_blob(state)
         gathered = jax.tree.map(lambda x: lax.all_gather(x, REPLICA_AXIS), blob)
-        heard = jnp.ones((R,), bool)
         my_id = lax.axis_index(REPLICA_AXIS).astype(jnp.int32)
+        heard_row = heard[0] | (jnp.arange(R) == my_id)
         new_state, out = step(
-            state, gathered, heard, req_vid[0], want_coord[0], my_id, local_cfg
+            state, gathered, heard_row, req_vid[0], want_coord[0], my_id,
+            local_cfg,
         )
         expand = lambda x: x[None]
         return jax.tree.map(expand, new_state), jax.tree.map(expand, out)
 
-    return jax.jit(_sharded)
+    fn = jax.jit(_sharded)
+
+    def run(states, req_vid, want_coord, heard=None):
+        if heard is None:
+            heard = jnp.ones((R, R), bool)
+        return fn(states, req_vid, want_coord, jnp.asarray(heard, bool))
+
+    return run
 
 
 def replicate_inputs(mesh: Mesh, states: EngineState, req_vid, want_coord):

