@@ -35,6 +35,15 @@ PAUSE_DONE = "pause_done"            # every active freed the row: -> PAUSED
 REACTIVATE = "reactivate"            # -> WAIT_ACK_START at a fresh row
 AR_ADD = "ar_add"                    # elastic membership: add an active
 AR_REMOVE = "ar_remove"              # elastic membership: remove an active
+# runtime reconfigurator membership (handleReconfigureRCNodeConfig analog,
+# ref Reconfigurator.java:1023-1075): the control plane grows/shrinks
+# ITSELF.  An intent arms a one-at-a-time transition (rc_next); the RC
+# record group then stops its current epoch and every surviving member
+# deterministically creates epoch e+1 under the target set; RC_NODE_DONE
+# commits the new set and re-splits ring ownership.
+RC_ADD_NODE = "rc_add"               # -> rc_next armed (target = cur + id)
+RC_REMOVE_NODE = "rc_remove"         # -> rc_next armed (target = cur - id)
+RC_NODE_DONE = "rc_done"             # transition complete: rc_nodes = target
 
 
 class RCRecordsApp(Replicable):
@@ -47,6 +56,13 @@ class RCRecordsApp(Replicable):
         # record analog, AbstractReconfiguratorDB.java:84-96); None means
         # "as configured at boot"
         self.ar_nodes: Optional[list] = None
+        # the replicated RECONFIGURATOR set (RC_NODES record analog) and
+        # the armed-but-uncommitted transition ({"target", "id", "kind"});
+        # rc_next also marks "control-plane change in progress" so
+        # concurrent membership ops serialize (the reference serializes
+        # NC changes through the NC record's own epoch)
+        self.rc_nodes: Optional[list] = None
+        self.rc_next: Optional[Dict] = None
         # fired after restore() replaces the whole state (checkpoint
         # transfer / recovery): the Reconfigurator refreshes its rings —
         # ar_nodes can change without any op executing locally
@@ -56,6 +72,11 @@ class RCRecordsApp(Replicable):
     def execute(self, request: Request, do_not_reply_to_client: bool = False) -> bool:
         assert isinstance(request, RequestPacket)
         op = json.loads(request.request_value)
+        if "__stop__" in op and "op" not in op:
+            # the RC group's own epoch-final stop (the RC-node transition):
+            # no record mutation — the manager's stop hook owns the switch
+            request.response_value = json.dumps({"ok": True})
+            return True
         applied = self._apply(op)
         op["applied"] = applied
         request.response_value = json.dumps({"ok": applied})
@@ -89,6 +110,39 @@ class RCRecordsApp(Replicable):
                             return False
                     cur.remove(nid)
             self.ar_nodes = sorted(cur)
+            return True
+        if kind in (RC_ADD_NODE, RC_REMOVE_NODE):
+            nid = int(op["id"])
+            cur = list(self.rc_nodes if self.rc_nodes is not None
+                       else op.get("boot_rcs") or [])
+            if self.rc_next is not None:
+                # a retransmitted duplicate of the armed transition applies
+                # True (idempotent re-arm); a DIFFERENT change is refused
+                # until the in-flight one commits (one NC change at a time)
+                if self.rc_next.get("id") == nid and \
+                        self.rc_next.get("kind") == kind:
+                    return True
+                return False
+            if kind == RC_ADD_NODE:
+                if nid in cur:
+                    op["noop"] = True  # already a member: ack, no transition
+                    return True
+                target = sorted(cur + [nid])
+            else:
+                if nid not in cur:
+                    op["noop"] = True
+                    return True
+                if len(cur) <= 1:
+                    return False  # never remove the last reconfigurator
+                target = sorted(x for x in cur if x != nid)
+            self.rc_next = {"target": target, "id": nid, "kind": kind}
+            return True
+        if kind == RC_NODE_DONE:
+            if self.rc_next is None or \
+                    list(op.get("target") or []) != list(self.rc_next["target"]):
+                return False  # duplicate/stale completion
+            self.rc_nodes = list(self.rc_next["target"])
+            self.rc_next = None
             return True
         name = op["name"]
         rec = self.records.get(name)
@@ -147,12 +201,16 @@ class RCRecordsApp(Replicable):
             "__fmt__": 2,  # versioned envelope: no service-name collisions
             "records": {n: r.to_json() for n, r in self.records.items()},
             "ar_nodes": self.ar_nodes,
+            "rc_nodes": self.rc_nodes,
+            "rc_next": self.rc_next,
         })
 
     def restore(self, name: str, state: Optional[str]) -> bool:
         if not state:
             self.records = {}
             self.ar_nodes = None
+            self.rc_nodes = None
+            self.rc_next = None
         else:
             d = json.loads(state)
             # accept: versioned envelope, the brief unversioned envelope
@@ -169,6 +227,8 @@ class RCRecordsApp(Replicable):
                 for n, r in d["records"].items()
             }
             self.ar_nodes = d.get("ar_nodes")
+            self.rc_nodes = d.get("rc_nodes")
+            self.rc_next = d.get("rc_next")
         if self.on_restored is not None:
             self.on_restored()
         return True

@@ -27,6 +27,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from ..manager import PaxosManager
 from ..protocoltask import ProtocolExecutor, ProtocolTask, ThresholdProtocolTask
 from ..utils.config import Config
+from .active_replica import stop_request_id
 from .chash import ConsistentHashing
 from .rc_config import RC
 from .rc_app import (
@@ -39,6 +40,9 @@ from .rc_app import (
     DROP_DONE,
     PAUSE_DONE,
     PAUSE_INTENT,
+    RC_ADD_NODE,
+    RC_NODE_DONE,
+    RC_REMOVE_NODE,
     REACTIVATE,
     RECONFIGURE_INTENT,
     STOP_DONE,
@@ -371,6 +375,45 @@ class DropEpochTask(ThresholdProtocolTask):
             cb()
 
 
+class RCJoinTask(ThresholdProtocolTask):
+    """Drive every member of the NEW reconfigurator epoch to host it
+    (the RC-node transition's start round, handleReconfigureRCNodeConfig
+    analog — ref ``Reconfigurator.java:1023-1075``).  Surviving members
+    created the epoch locally at stop time and ack immediately; a joining
+    node blank-creates it and heals through the manager's state-transfer
+    (which carries app state + dedup entries).  All-ack threshold: the
+    transition only commits (RC_NODE_DONE) once every member of the new
+    control plane hosts the record RSM."""
+
+    restart_period_s = 1.0
+    max_lifetime_s = 120.0
+
+    def __init__(self, key: str, rcf: "Reconfigurator", epoch: int,
+                 members: List[int], row: int,
+                 on_all: Callable[[], None]):
+        super().__init__(key, members, threshold=len(members))
+        self.rcf = rcf
+        self.epoch = int(epoch)
+        self.members = [int(m) for m in members]
+        self.row = int(row)
+        self._on_all = on_all
+
+    def send_to(self, node):
+        return (("RC", node), "rc_join", {
+            "epoch": self.epoch, "members": self.members, "row": self.row,
+            "rc": ["RC", self.rcf.my_id],
+        })
+
+    def is_ack(self, kind, body):
+        if kind == "ack_rc_join" and int(body["epoch"]) == self.epoch:
+            return int(body["from"])
+        return None
+
+    def on_threshold(self):
+        self._on_all()
+        return ()
+
+
 class Reconfigurator:
     def __init__(
         self,
@@ -407,7 +450,12 @@ class Reconfigurator:
                 else self._boot_actives)
         self.ar_ids = set(int(a) for a in live)
         self.ar_ring = ConsistentHashing(sorted(self.ar_ids))
-        self.rc_ring = ConsistentHashing(reconfigurators)
+        # the RC ring re-splits record ownership when the control plane
+        # itself grows/shrinks (RC_ADD_NODE/RC_REMOVE_NODE): the replicated
+        # set wins over the boot configuration, and a transition past its
+        # stop point hands ownership to the TARGET set
+        self._boot_rcs = sorted(int(r) for r in reconfigurators)
+        self.rc_ring = ConsistentHashing(self._rc_set())
         # RC-peer liveness for primary takeover (default: all alive)
         self.is_node_up = is_node_up or (lambda _rc: True)
         # demand aggregation at the record's primary (handleDemandReport)
@@ -441,8 +489,15 @@ class Reconfigurator:
         # strand the other)
         self._batch_of: Dict[str, set] = {}
         self._tick_count = 0
+        # RC-node transition scratch: the stop-time capture of the record
+        # RSM ({"from_epoch", "row", "old"}) — set by the manager's stop
+        # hook, consumed by _advance_rc_transition on the next tick (the
+        # hook fires inside the manager's execution loop; group surgery is
+        # deferred out of it)
+        self._rc_final: Optional[Dict] = None
         rc_app.on_applied = self._on_applied
-        rc_app.on_restored = self._refresh_ar_ring
+        rc_app.on_restored = self._refresh_rings
+        rc_manager.on_stop_executed = self._on_rc_stop
 
     # ------------------------------------------------------------------
     def primary_of(self, name: str) -> int:
@@ -524,10 +579,19 @@ class Reconfigurator:
             self._handle_demand_report(body)
         elif kind in ("add_active", "remove_active"):
             self._handle_membership(kind, body)
+        elif kind in ("add_reconfigurator", "remove_reconfigurator"):
+            self._handle_rc_membership(kind, body)
+        elif kind == "rc_join":
+            self._handle_rc_join(body)
+        elif kind == "ack_rc_join":
+            self.tasks.handle_event(
+                f"rcjoin:{int(body['epoch'])}", kind, body
+            )
 
     def tick(self, now: Optional[float] = None) -> None:
         self.tasks.tick(now)
         self._tick_count += 1
+        self._advance_rc_transition()
         if self._tick_count % self.REDRIVE_EVERY == 0:
             self._redrive_records()
             self._redrive_unfinished_drops()
@@ -772,32 +836,261 @@ class Reconfigurator:
     # ---- elastic membership (handleReconfigureActiveNodeConfig,
     # Reconfigurator.java:1023-1075) -------------------------------------
     def _handle_membership(self, kind: str, body: Dict) -> None:
-        nid = int(body["id"])
-        if not (0 <= nid < 32):
-            # engine membership is a 32-bit replica-id bitmask; a larger
-            # id would commit an unrepresentable member and wedge groups
-            self._reply(body, f"{kind}_ack", str(nid), id=nid, ok=False,
-                        reason="bad-id")
+        nid = self._membership_ingress(kind, body, "#m")
+        if nid is None:
             return
-        if body.get("client") is not None:
-            # a LIST: concurrent requesters of the same op must all be
-            # acked by the single committed apply, not just the last
-            self._pending_clients.setdefault(
-                f"#m:{kind}:{nid}", []
-            ).append(body["client"])
-        # always propose — the RSM applies idempotently, so the committed
-        # outcome (not this RC's possibly-stale local view) decides the ack
         self.propose_op({
             "op": AR_ADD if kind == "add_active" else AR_REMOVE,
             "id": nid,
             "boot_actives": sorted(self.ar_ids),
         })
 
+    # ------------------------------------------------------------------
+    # runtime reconfigurator membership (handleReconfigureRCNodeConfig
+    # analog, ref Reconfigurator.java:1023-1075): the record RSM stops its
+    # current epoch and restarts under the target set; ring ownership of
+    # every record re-splits at the stop point
+    # ------------------------------------------------------------------
+    def _membership_ingress(self, kind: str, body: Dict,
+                            key_prefix: str) -> Optional[int]:
+        """Shared AR/RC membership ingress: id-mask guard (engine
+        membership is a 32-bit bitmask), concurrent-requester client list,
+        and the always-propose rule (the committed outcome — not this
+        RC's possibly-stale local view — decides the ack)."""
+        nid = int(body["id"])
+        if not (0 <= nid < 32):
+            self._reply(body, f"{kind}_ack", str(nid), id=nid, ok=False,
+                        reason="bad-id")
+            return None
+        if body.get("client") is not None:
+            self._pending_clients.setdefault(
+                f"{key_prefix}:{kind}:{nid}", []
+            ).append(body["client"])
+        return nid
+
+    def _handle_rc_membership(self, kind: str, body: Dict) -> None:
+        nid = self._membership_ingress(kind, body, "#rc")
+        if nid is None:
+            return
+        self.propose_op({
+            "op": RC_ADD_NODE if kind == "add_reconfigurator"
+            else RC_REMOVE_NODE,
+            "id": nid,
+            "boot_rcs": self._rc_set(),
+        })
+
+    def _ack_rc_membership(self, op: Dict, ok: bool,
+                           reason: Optional[str] = None) -> None:
+        kind = ("add_reconfigurator" if op["op"] == RC_ADD_NODE
+                else "remove_reconfigurator")
+        clients = self._pending_clients.pop(
+            f"#rc:{kind}:{int(op['id'])}", None
+        )
+        for client in clients or []:
+            body = {"id": int(op["id"]), "name": str(op["id"]), "ok": ok,
+                    "reconfigurators": self._rc_set()}
+            if reason:
+                body["reason"] = reason
+            self.send(tuple(client), f"{kind}_ack", body)
+
+    def _rc_transition_driver(self, cands: List[int]) -> bool:
+        """Deterministic transition driver with liveness takeover: the
+        first live candidate in sorted order (WaitPrimaryExecution-style
+        — a dead driver's duties fall to the next survivor)."""
+        for rc in sorted(set(int(c) for c in cands)):
+            if rc == self.my_id:
+                return True
+            if self.is_node_up(rc):
+                return False
+        return False
+
+    def _on_rc_stop(self, name: str, row: int, epoch: int) -> None:
+        """Manager hook: the record RSM's own epoch-final stop executed.
+        Capture the transition point; the group surgery happens on the
+        next tick (this hook fires inside the manager's execution loop)."""
+        if name != RC_GROUP:
+            return
+        old = self.rc_manager.get_replica_group(RC_GROUP) or []
+        self._rc_final = {
+            "from_epoch": int(epoch), "row": int(row),
+            "old": [int(m) for m in old],
+        }
+
+    def _rc_row(self, new_epoch: int, avoid: set) -> Optional[int]:
+        """Deterministic row for the record RSM's next epoch, skipping
+        occupied rows.  None when no free row exists (a one-row RC
+        engine): the caller must free the old row before creating."""
+        G = self.rc_manager.cfg.n_groups
+        for attempt in range(G):
+            r = row_for(RC_GROUP, new_epoch, attempt, G)
+            if r not in avoid:
+                return r
+        return None
+
+    def _advance_rc_transition(self) -> None:
+        """Per-tick driver of an armed RC-node transition (idempotent, so
+        a restarted/laggard RC re-walks whatever phase it finds itself in):
+
+          phase 1 (pre-stop): the driver proposes the epoch-final stop on
+            the record RSM (deterministic request id — every member may
+            propose, dedup collapses to one execution);
+          phase 2 (stop executed locally): surviving members re-create the
+            RSM at epoch+1 under the target set from their own stop-time
+            state; the removed node GCs its row and drops out;
+          phase 3 (post, driver): an RCJoinTask drives every target member
+            to host the new epoch (survivors ack immediately, joiners
+            blank-create and heal via state transfer), then RC_NODE_DONE
+            commits the new set."""
+        nxt = self.rc_app.rc_next
+        fin = self._rc_final
+        if nxt is None and fin is None:
+            return
+        mgr = self.rc_manager
+        cur = mgr.current_epoch(RC_GROUP)
+        if nxt is None:
+            self._rc_final = None  # transition committed: scratch done
+            return
+        target = [int(x) for x in nxt["target"]]
+        members = sorted(mgr.get_replica_group(RC_GROUP) or [])
+        post = members == target and cur is not None
+        if post:
+            # phase 3: drive joins, then commit the new set.  The driver
+            # pool is the SURVIVOR set (target ∩ stop-time members): a
+            # joiner can't drive before it joins (its rc_next is empty),
+            # so deferring to a joiner that sorts first — e.g. adding id 0
+            # under members [1,2,3] — would deadlock the transition.  A
+            # restarted survivor that lost the stop-time capture falls
+            # back to the full target: by then a joiner defers only if it
+            # completed its join (rc_next restored via state transfer),
+            # at which point it CAN drive.
+            drivers = (
+                sorted(set(target) & set(fin["old"]))
+                if fin is not None and set(target) & set(fin["old"])
+                else target
+            )
+            if not self._rc_transition_driver(drivers):
+                return
+            row = mgr.epoch_row(RC_GROUP, cur)
+            key = f"rcjoin:{cur}"
+
+            def commit_done(tgt=target, nid=int(nxt["id"]),
+                            knd=nxt["kind"]):
+                self.propose_op({
+                    "op": RC_NODE_DONE, "target": tgt, "id": nid,
+                    "kind": knd,
+                })
+
+            self.tasks.spawn_if_not_running(
+                key, lambda: RCJoinTask(
+                    key, self, cur, target, int(row), on_all=commit_done
+                )
+            )
+            return
+        if fin is not None and cur == fin["from_epoch"]:
+            # phase 2: the stop executed here — switch epochs locally
+            new_epoch = cur + 1
+            new_row = self._rc_row(new_epoch, avoid={int(fin["row"])})
+            if self.my_id in target:
+                # my stop-time app state IS the final state (RSM
+                # invariant); my dedup entries are already in my cache
+                state = mgr.app.checkpoint(RC_GROUP)
+                if new_row is None:
+                    # one-row engine: the old row must free first
+                    mgr.kill_epoch(RC_GROUP, cur)
+                    new_row = int(fin["row"])
+                    mgr.create_paxos_instance(
+                        RC_GROUP, target, initial_state=state,
+                        version=new_epoch, row=new_row, pending=False,
+                    )
+                else:
+                    mgr.create_paxos_instance(
+                        RC_GROUP, target, initial_state=state,
+                        version=new_epoch, row=new_row, pending=False,
+                    )
+                    mgr.kill_epoch(RC_GROUP, cur)
+            else:
+                # removed from the control plane: GC and step aside (still
+                # forwards client traffic via the refreshed ring)
+                mgr.kill_epoch(RC_GROUP, cur)
+            self._refresh_rings()
+            return
+        if cur is not None and self.my_id in members \
+                and not mgr.is_stopped(RC_GROUP):
+            # phase 1: stop not yet decided — the driver (re-)proposes it
+            if self._rc_transition_driver(
+                sorted(set(members) & set(target)) or members
+            ):
+                mgr.propose(
+                    RC_GROUP, json.dumps({"__stop__": int(cur)}), stop=True,
+                    request_id=stop_request_id(RC_GROUP, int(cur)),
+                )
+
+    def _handle_rc_join(self, body: Dict) -> None:
+        """A transition driver asks this node to host the record RSM's new
+        epoch.  Survivors already host it (ack); a joiner blank-creates at
+        the carried row and heals app state + dedup through the manager's
+        state transfer (the same machinery as an AR blank join)."""
+        epoch, row = int(body["epoch"]), int(body["row"])
+        target = [int(m) for m in body["members"]]
+        mgr = self.rc_manager
+        cur = mgr.current_epoch(RC_GROUP)
+        if cur is None or cur < epoch:
+            if cur is not None:
+                cur_members = mgr.get_replica_group(RC_GROUP) or []
+                if self.my_id in cur_members:
+                    if not mgr.is_stopped(RC_GROUP):
+                        # live member lagging the stop: my own stop
+                        # execution advances me; the join retransmit
+                        # finds me hosting the epoch afterwards
+                        return
+                    # stopped but scratch lost (restart): fall through —
+                    # resume_group's epoch-upgrade path re-maps the name
+                else:
+                    # frozen non-member leftover of the old ring: it holds
+                    # no obligations (it never voted) — free the row
+                    mgr.kill(RC_GROUP)
+            try:
+                ok = mgr.resume_group(
+                    RC_GROUP, epoch, target, row, pending=False
+                )
+            except RuntimeError:
+                return  # row occupied locally; retransmit retries after GC
+            if not ok:
+                return
+            if cur is not None:
+                # the resume's epoch-upgrade demoted my stopped old row
+                # into old_epochs — GC it (phase 2 does the same for the
+                # in-memory path; leaking it would collide with a later
+                # transition's deterministic row and wedge that join)
+                mgr.kill_epoch(RC_GROUP, cur)
+            self._refresh_rings()
+        if (mgr.current_epoch(RC_GROUP) or -1) >= epoch:
+            self.send(tuple(body["rc"]), "ack_rc_join", {
+                "epoch": epoch, "from": self.my_id,
+            })
+
     def _refresh_ar_ring(self) -> None:
         live = (self.rc_app.ar_nodes if self.rc_app.ar_nodes is not None
                 else self._boot_actives)
         self.ar_ids = set(int(a) for a in live)
         self.ar_ring = ConsistentHashing(sorted(self.ar_ids))
+
+    def _rc_set(self) -> List[int]:
+        """The effective reconfigurator set.  During a transition whose
+        stop point has passed (rc_next armed), ownership belongs to the
+        TARGET set: the rings of nodes that learned the target via the
+        stop / a join / a checkpoint adoption must agree, and a node that
+        only ever sees the post-transition state (a fresh joiner restoring
+        mid-transition) has nothing else to go by."""
+        if self.rc_app.rc_next is not None:
+            return [int(x) for x in self.rc_app.rc_next["target"]]
+        if self.rc_app.rc_nodes is not None:
+            return [int(x) for x in self.rc_app.rc_nodes]
+        return list(self._boot_rcs)
+
+    def _refresh_rings(self) -> None:
+        self._refresh_ar_ring()
+        self.rc_ring = ConsistentHashing(self._rc_set())
 
     def _rehome_set(self, name: str, actives: List[int]) -> List[int]:
         """Replacement set after membership loss: keep surviving members,
@@ -1143,6 +1436,25 @@ class Reconfigurator:
                     "ok": bool(op.get("applied")),
                     "actives": sorted(self.ar_ids),
                 })
+            return
+        if op["op"] in (RC_ADD_NODE, RC_REMOVE_NODE):
+            if not op.get("applied"):
+                # refused: another transition in flight, or removing the
+                # last reconfigurator
+                self._ack_rc_membership(op, ok=False, reason="refused")
+            elif op.get("noop"):
+                self._ack_rc_membership(op, ok=True)
+            # applied + armed: _advance_rc_transition drives the epochs;
+            # the client is answered when RC_NODE_DONE commits
+            return
+        if op["op"] == RC_NODE_DONE:
+            if op.get("applied"):
+                self._refresh_rings()
+                self._rc_final = None
+                self._ack_rc_membership(
+                    {"op": op.get("kind", RC_ADD_NODE), "id": op["id"]},
+                    ok=True,
+                )
             return
         name = op["name"]
         if not op.get("applied") or not self.is_primary(name):

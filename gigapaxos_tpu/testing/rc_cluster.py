@@ -32,10 +32,18 @@ class ReconfigurableCluster:
         ar_log_dirs: Optional[List[str]] = None,
         rc_log_dirs: Optional[List[str]] = None,
         demand_profile_cls=None,
+        rc_members: Optional[List[int]] = None,
     ):
+        """``rc_members`` boots the record RSM on a SUBSET of the RC nodes;
+        the rest run as standbys addressable for a later runtime
+        add_reconfigurator (ref tests 31/32 boot spare RCs the same way)."""
         n_ar, n_rc = ar_cfg.n_replicas, rc_cfg.n_replicas
         self.ar_ids = list(range(n_ar))
         self.rc_ids = list(range(n_rc))
+        self.rc_members = (
+            sorted(int(r) for r in rc_members) if rc_members is not None
+            else list(self.rc_ids)
+        )
         # reconfiguration-plane message queues (current + next round)
         self._inboxes: Dict[Addr, List[Tuple[str, Dict]]] = {}
         self.client_inbox: List[Tuple[str, Dict]] = []
@@ -62,7 +70,7 @@ class ReconfigurableCluster:
         for j in self.rc_ids:
             mgr = self.rcs.managers[j]
             self.reconfigurators.append(Reconfigurator(
-                j, mgr, mgr.app, self.ar_ids, self.rc_ids, self._sender(),
+                j, mgr, mgr.app, self.ar_ids, self.rc_members, self._sender(),
                 ar_n_groups=ar_cfg.n_groups,
                 is_node_up=lambda rc: rc not in self.dead_rcs,
                 demand_profiler=(
@@ -71,8 +79,10 @@ class ReconfigurableCluster:
                 ),
             ))
         # bootstrap the RC-record RSM on every reconfigurator (the
-        # AR_RC_NODES-style special group, created deterministically)
-        self.rcs.create(RC_GROUP, members=self.rc_ids)
+        # AR_RC_NODES-style special group, created deterministically);
+        # standby nodes host the row frozen (non-member) until a runtime
+        # add_reconfigurator brings them in
+        self.rcs.create(RC_GROUP, members=self.rc_members)
 
     def _sender(self) -> Callable[[Addr, str, Dict], None]:
         def send(dst: Addr, kind: str, body: Dict) -> None:
