@@ -1,0 +1,319 @@
+"""Reusable chaos soak: the randomized reconfiguration-plane adversarial
+run shared by the CI test (:mod:`tests.test_chaos`) and the varied-seed
+sweep harness (``scripts/chaos_sweep.py``).
+
+One call = one seeded soak (the reference's randomized
+``TESTReconfiguration*`` suites compressed into a single adversarial run:
+creates, migrations, pauses, touches, deletes, elastic membership churn,
+app traffic — all under 20% control-plane loss), then a lossless settle
+and a strict end-state audit:
+
+  * every surviving record settles READY/PAUSED (no wedged WAIT_*);
+  * RC record agreement across reconfigurators;
+  * deleted names gone everywhere; paused names hold pause records;
+  * READY actives host the name at one aligned row;
+  * RSM invariant: live members agree on app state, AND on the engine's
+    ``(exec_slot, n_execd, app_hash)`` triple — a member with n_execd+1
+    at an equal frontier executed something twice (exactly-once breach,
+    ref semantics ``PaxosManager.java:318-346``).
+
+Violations raise :class:`SoakDivergence` carrying per-member engine and
+dedup diagnostics so a failing seed is actionable, not just red.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Dict, List, Optional
+
+from ..models.apps import HashChainApp
+from ..ops.engine import EngineConfig
+from ..reconfiguration import RCState
+from ..utils.config import Config
+from .rc_cluster import ReconfigurableCluster
+
+
+class SoakDivergence(AssertionError):
+    """End-state invariant violation; .diag holds the evidence."""
+
+    def __init__(self, msg: str, diag: Optional[Dict] = None):
+        super().__init__(msg if diag is None else f"{msg}: {diag}")
+        self.diag = diag or {}
+
+
+def _name_diag(c: ReconfigurableCluster, nm: str, actives: List[int]) -> Dict:
+    """Per-member engine + dedup evidence for one name."""
+    out = {}
+    for a in actives:
+        m = c.ars.managers[a]
+        row = m.names.get(nm)
+        ent = {
+            "row": row,
+            "app_state": m.app.state.get(nm),
+            "app_n_executed": getattr(m.app, "n_executed", {}).get(nm),
+        }
+        if row is not None:
+            ent.update(
+                exec_slot=int(m._np("exec_slot")[row]),
+                n_execd=int(m._np("n_execd")[row]),
+                app_hash=int(m._np("app_hash")[row]),
+                version=int(m._np("version")[row]),
+            )
+        ent["dedup"] = sorted(m.dedup_for_name(nm))
+        out[a] = ent
+    return out
+
+
+def probe_exactly_once(c: ReconfigurableCluster, names) -> None:
+    """Transient safety probe, safe to run after EVERY step: two members
+    fully caught up (app cursor == device frontier, no pending heal) on
+    the same (name, epoch) at the SAME frontier executed the same decided
+    sequence — their app states must match.  A mismatch is the
+    duplicate-execution signature (a dedup entry lost in a handoff) the
+    moment it is born, before a later checkpoint-jump adoption can mask
+    it."""
+    for nm in names:
+        groups: Dict = {}
+        for a, m in enumerate(c.ars.managers):
+            row = m.names.get(nm)
+            if row is None or row in m.pending_rows \
+                    or row in m._needs_state:
+                continue
+            exec_now = int(m._np("exec_slot")[row])
+            if int(m.app_exec_slot[row]) != exec_now or exec_now == 0:
+                continue  # mid-execution / just born: prefix not comparable
+            key = (int(m._np("version")[row]), exec_now)
+            groups.setdefault(key, []).append((a, m.app.state.get(nm)))
+        for (ver, fr), members in groups.items():
+            states = {s for _, s in members}
+            if len(states) > 1:
+                raise SoakDivergence(
+                    "exactly-once breach (transient): caught-up members at "
+                    "one (epoch, frontier) disagree on app state",
+                    {"name": nm, "epoch": ver, "frontier": fr,
+                     "members": _name_diag(c, nm, [a for a, _ in members])},
+                )
+
+
+def run_soak(
+    seed: int,
+    *,
+    rounds: int = 60,
+    n_names: int = 6,
+    ar_cfg: Optional[EngineConfig] = None,
+    rc_cfg: Optional[EngineConfig] = None,
+    settle_budget_s: float = 420.0,
+    loss: float = 0.2,
+) -> Dict:
+    """Run one seeded soak; raises :class:`SoakDivergence` on violation.
+
+    Returns a small stats dict (rounds run, settle iterations) on success.
+    """
+    from ..reconfiguration import active_replica as ar_mod
+    from ..reconfiguration import reconfigurator as rc_mod
+
+    task_classes = (
+        rc_mod.StartEpochTask, rc_mod.StopEpochTask, rc_mod.DropEpochTask,
+        rc_mod.EpochCommitTask, rc_mod.LateStartTask, rc_mod.PauseEpochTask,
+        ar_mod.WaitEpochFinalState,
+    )
+    saved_periods = [cls.restart_period_s for cls in task_classes]
+    c = None
+    try:
+        # fast retransmits so recovery happens within the soak budget
+        # (inside the try: a construction failure below must still restore
+        # these process-wide mutations in the finally)
+        for cls in task_classes:
+            cls.restart_period_s = 0.05
+        # exactly-once is only guaranteed within the response-cache TTL; a
+        # loaded box can stretch one soak across minutes, and TTL-expired
+        # dedup entries re-executing re-proposed duplicates is a documented
+        # semantics boundary, not what this probes.  Pin the window wide.
+        Config.set("RESPONSE_CACHE_TTL_S", "3600")
+
+        rng = random.Random(seed)
+        ar_cfg = ar_cfg or EngineConfig(
+            n_groups=24, window=8, req_lanes=4, n_replicas=4
+        )
+        rc_cfg = rc_cfg or EngineConfig(
+            n_groups=8, window=8, req_lanes=4, n_replicas=3
+        )
+        n_ar = ar_cfg.n_replicas
+        c = ReconfigurableCluster(ar_cfg, rc_cfg, HashChainApp)
+        for rc in c.reconfigurators:
+            rc.REDRIVE_EVERY = 4
+        names = [f"n{i}" for i in range(n_names)]
+
+        def step():
+            c.step()
+            probe_exactly_once(c, names)
+
+        deleted: set = set()
+        c.msg_filter = lambda dst, kind, body: rng.random() > loss
+
+        for nm in names:
+            c.client_request(
+                "create_service",
+                {"name": nm, "actives": list(range(min(3, n_ar)))},
+            )
+        for _ in range(40):
+            step()
+
+        for round_no in range(rounds):
+            op = rng.random()
+            nm = rng.choice(names)
+            if op < 0.35:  # traffic
+                entry = rng.randrange(n_ar)
+                c.ars.managers[entry].propose(nm, f"r{round_no}")
+            elif op < 0.55:  # migrate to a random 3-set
+                target = rng.sample(range(n_ar), 3)
+                c.client_request(
+                    "reconfigure", {"name": nm, "new_actives": target}
+                )
+            elif op < 0.7:  # pause suggestion
+                rec = c.reconfigurators[0].rc_app.get_record(nm)
+                if rec is not None and not rec.deleted:
+                    c.active_replicas[0].send(
+                        ("RC", rng.randrange(rc_cfg.n_replicas)),
+                        "suggest_pause",
+                        {"name": nm, "epoch": rec.epoch, "from": 0},
+                    )
+            elif op < 0.85:  # touch (reactivates if paused)
+                c.client_request("request_actives", {"name": nm})
+            elif op < 0.92:  # elastic membership churn: remove, re-add
+                removed = getattr(c, "_chaos_removed", None)
+                if removed is None:
+                    c.client_request(
+                        "remove_active", {"id": rng.randrange(n_ar)}
+                    )
+                    c._chaos_removed = True
+                else:
+                    for nid in range(n_ar):
+                        c.client_request("add_active", {"id": nid})
+                    c._chaos_removed = None
+            elif nm not in deleted and len(deleted) < 2:  # delete (max 2)
+                c.client_request("delete_service", {"name": nm})
+                deleted.add(nm)
+            step()
+            c.drain_client()
+
+        # lossless settle, deadline-bound (cold jax compiles and rare
+        # time-gated retransmits burn wall time, not steps)
+        c.msg_filter = None
+        deadline = time.time() + settle_budget_s
+        settled, settle_iters = False, 0
+        while not settled:
+            if time.time() > deadline:
+                break
+            for _ in range(8):
+                step()
+            c.drain_client()
+            settle_iters += 1
+            recs = {
+                nm: c.reconfigurators[0].rc_app.get_record(nm)
+                for nm in names
+            }
+            settled = all(
+                r is None or r.deleted
+                or r.state in (RCState.READY, RCState.PAUSED)
+                for r in recs.values()
+            )
+        if not settled:
+            raise SoakDivergence(
+                "records did not settle",
+                {nm: (r.to_json() if r else None) for nm, r in recs.items()},
+            )
+
+        # record agreement across RCs
+        for nm in names:
+            views = [rc.rc_app.get_record(nm) for rc in c.reconfigurators]
+            datas = [None if v is None else v.to_json() for v in views]
+            if not all(d == datas[0] for d in datas):
+                raise SoakDivergence("RC record disagreement",
+                                     {"name": nm, "views": datas})
+
+        for nm, rec in recs.items():
+            if rec is None or rec.deleted:
+                for m in c.ars.managers:
+                    if m.names.get(nm) is not None:
+                        raise SoakDivergence(
+                            "name lingers post-delete",
+                            {"name": nm, "member": m.rid},
+                        )
+                continue
+            if rec.state is RCState.PAUSED:
+                held = [m for m in c.ars.managers
+                        if (nm, rec.epoch) in m.paused]
+                if not held:
+                    raise SoakDivergence(
+                        "paused with no pause records anywhere", {"name": nm}
+                    )
+                continue
+            # READY: actives host the name at ONE aligned row and agree.
+            # Re-read each poll: the deactivation sweep can pause a name
+            # mid-poll; commit-round re-drives heal missed starts.
+            rows: set = set()
+            for _ in range(600):
+                rec = c.reconfigurators[0].rc_app.get_record(nm)
+                if rec is None or rec.deleted or \
+                        rec.state is not RCState.READY:
+                    break
+                rows = {c.ars.managers[a].names.get(nm) for a in rec.actives}
+                if rows == {rec.row}:
+                    break
+                step()
+            else:
+                rows = {c.ars.managers[a].names.get(nm) for a in rec.actives}
+            if rec is None or rec.deleted or rec.state is not RCState.READY:
+                continue
+            if rows != {rec.row}:
+                raise SoakDivergence(
+                    "READY actives not aligned at record row",
+                    {"name": nm, "want_row": rec.row, "rows": sorted(
+                        (a, c.ars.managers[a].names.get(nm))
+                        for a in rec.actives)},
+                )
+            # RSM convergence: poll app state AND the engine triple (a
+            # laggard may need many blocked-pull rounds); then audit
+            # exactly-once — equal frontiers must mean equal n_execd and
+            # equal app_hash.
+            converged = False
+            for _ in range(800):
+                states = {
+                    c.ars.managers[a].app.state.get(nm) for a in rec.actives
+                }
+                fr = {
+                    int(c.ars.managers[a]._np("exec_slot")[
+                        c.ars.managers[a].names[nm]])
+                    for a in rec.actives
+                    if c.ars.managers[a].names.get(nm) is not None
+                }
+                if len(states) == 1 and len(fr) == 1:
+                    converged = True
+                    break
+                step()
+            if not converged:
+                raise SoakDivergence(
+                    "RSM divergence (app state or frontier never converged)",
+                    {"name": nm, "members": _name_diag(c, nm, rec.actives)},
+                )
+            # equal frontiers ⇒ n_execd and app_hash must match exactly
+            diag = _name_diag(c, nm, rec.actives)
+            trips = {
+                (e["exec_slot"], e["n_execd"], e["app_hash"])
+                for e in diag.values() if "exec_slot" in e
+            }
+            if len(trips) != 1:
+                raise SoakDivergence(
+                    "exactly-once breach: unequal (exec_slot, n_execd, "
+                    "app_hash) at converged app state",
+                    {"name": nm, "members": diag},
+                )
+        return {"seed": seed, "settle_iters": settle_iters}
+    finally:
+        if c is not None:
+            c.close()
+        Config.clear()
+        for cls, p in zip(task_classes, saved_periods):
+            cls.restart_period_s = p

@@ -1,0 +1,57 @@
+"""Varied-seed chaos sweep: run the soak over many seeds in ONE process
+(so jax compiles once), reporting every failing seed with diagnostics.
+
+Usage:  python scripts/chaos_sweep.py --base 1 --count 100 [--stride 7919]
+"""
+
+import argparse
+import os
+import sys
+import time
+import traceback
+
+# host-sim sweeps run on CPU (the TPU tunnel would route every tiny host
+# dispatch over the network); a site hook can override jax_platforms at
+# interpreter startup, so also force the config back after import
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, ".")
+
+from gigapaxos_tpu.testing.chaos import run_soak  # noqa: E402
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--base", type=int, default=1)
+    ap.add_argument("--count", type=int, default=50)
+    ap.add_argument("--stride", type=int, default=7919)
+    ap.add_argument("--budget-s", type=float, default=None,
+                    help="stop starting new seeds after this much wall time")
+    args = ap.parse_args()
+
+    fails = []
+    t0 = time.time()
+    done = 0
+    for i in range(args.count):
+        seed = args.base + i * args.stride
+        t = time.time()
+        try:
+            run_soak(seed)
+            print(f"[{i}] seed={seed} OK {time.time() - t:.1f}s", flush=True)
+        except Exception as e:
+            print(f"[{i}] seed={seed} FAIL {time.time() - t:.1f}s: {e}",
+                  flush=True)
+            traceback.print_exc()
+            fails.append(seed)
+        done += 1
+        if args.budget_s is not None and time.time() - t0 > args.budget_s:
+            break
+    print(f"DONE ran={done} fails={fails}", flush=True)
+    sys.exit(1 if fails else 0)
+
+
+if __name__ == "__main__":
+    main()
