@@ -966,6 +966,9 @@ class PaxosManager:
             "app_exec": int(self.app_exec_slot[row]),
             "acc": acc, "dec": dec,
             "dedup": self.dedup_for_name(name),
+            # member set rides along so a LOCAL restore (hibernate wake-up)
+            # needs no reconfigurator round to learn the group
+            "members": self.get_replica_group(name),
         }
 
     def resume_group(
@@ -1068,6 +1071,61 @@ class PaxosManager:
                     )
             self.row_activity[r] = time.time()
             return True
+
+    # ------------------------------------------------------------------
+    # hibernate / restore (checkpoint + sleep on disk; local wake-up —
+    # PaxosManager.hibernate:2209-2227 / restore:2230-2252)
+    # ------------------------------------------------------------------
+    def hibernate(self, name: str) -> bool:
+        """Checkpoint (name)'s current epoch durably and release the row
+        AND its RAM — the instance sleeps on disk.  Unlike the
+        RC-coordinated pause (capacity residency), this is a LOCAL op:
+        the snapshot is forced (window remnants ride along), and
+        :meth:`restore` wakes it locally from the journaled record with a
+        full rollback to that snapshot, no reconfigurator round."""
+        with self._state_lock:
+            row = self.names.get(name)
+            if row is None:
+                return False
+            epoch = int(self._np("version")[row])
+        if self.pause_group(name, epoch, force=True) != "ok":
+            return False
+        # page the record out of RAM when the paused table can spill
+        # (reference: softCrash removes the instance object entirely; the
+        # journaled pause record is the disk copy that outlives us)
+        if hasattr(self.paused, "demote"):
+            self.paused.demote((name, epoch))
+        return True
+
+    def restore(self, name: str) -> bool:
+        """Wake a hibernated instance: roll back to its journaled
+        snapshot at a locally chosen row.  Row choice is the same
+        deterministic ``default_row_for`` probe every member uses, so a
+        cluster whose members hibernated/restored the same set of names
+        re-aligns; deployments that cannot guarantee that use the
+        RC-coordinated resume (which carries the row)."""
+        with self._state_lock:
+            if self.names.get(name) is not None:
+                return True  # already awake
+            epochs = [int(e) for (n, e) in self.paused if n == name]
+        if not epochs:
+            return False
+        epoch = max(epochs)
+        with self._state_lock:
+            rec = self.paused.get((name, epoch))
+            if rec is None:
+                return False
+            members = rec.get("members")
+        if not members:
+            return False
+        try:
+            row = self.default_row_for(name)
+            return self.resume_group(name, epoch, members, row,
+                                     pending=False)
+        except RuntimeError:
+            # capacity exhausted / row collision: a failed wake-up the
+            # caller can retry after freeing rows, not a crash
+            return False
 
     def pending_row_keys(self) -> List[Tuple[str, int, int]]:
         """(name, epoch, row) for every row still behind the pre-COMPLETE

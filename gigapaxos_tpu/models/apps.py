@@ -69,6 +69,29 @@ class StatefulAdderApp(Replicable):
         return RequestPacket(request_value=stringified)
 
 
+class LinWritesLocReadsApp(StatefulAdderApp):
+    """Linearizable writes, local reads (ref:
+    ``examples/linwrites/LinWritesLocReadsApp.java:23`` over
+    ``SimpleAppRequest.java:32`` COORDINATED_WRITE/LOCAL_READ): delta
+    values coordinate through consensus like the adder; the ``"read"``
+    request executes UNCOORDINATED against this replica's local state —
+    sequentially-consistent reads at zero consensus cost.  The
+    coordinator consults :meth:`is_coordinated` to route."""
+
+    READ = "read"
+
+    def is_coordinated(self, value: str) -> bool:
+        return value != self.READ
+
+    def execute(self, request: Request, do_not_reply_to_client: bool = False) -> bool:
+        if getattr(request, "request_value", "") == self.READ:
+            name = request.get_service_name()
+            if hasattr(request, "response_value"):
+                request.response_value = str(self.totals.get(name, 0))
+            return True
+        return super().execute(request, do_not_reply_to_client)
+
+
 class HashChainApp(Replicable):
     """SHA-chained state: state' = sha256(state || request_value)."""
 

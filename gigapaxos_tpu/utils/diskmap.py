@@ -52,20 +52,23 @@ class DiskMap(MutableMapping):
         h = hashlib.blake2b(repr(key).encode(), digest_size=12).hexdigest()
         return f"{h}.dm"
 
+    def _spill_one(self, key: Any) -> None:
+        """Page one in-memory entry out.  Write-before-pop: a failed
+        spill (ENOSPC) must not lose the entry — it stays in memory and
+        the error surfaces to the caller."""
+        value = self._mem[key]
+        fname = self._fname(key)
+        path = os.path.join(self.dir, fname)
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(self._ser(value))
+        del self._mem[key]
+        self._on_disk[key] = fname
+
     def _spill_lru(self) -> None:
-        """Page out the least-recently-used half (Deactivator batch).
-        Write-before-pop: a failed spill (ENOSPC) must not lose the entry
-        — it stays in memory and the error surfaces to the caller."""
+        """Page out the least-recently-used half (Deactivator batch)."""
         n = max(1, len(self._mem) - self.capacity // 2)
         for _ in range(n):
-            key = next(iter(self._mem))
-            value = self._mem[key]
-            fname = self._fname(key)
-            path = os.path.join(self.dir, fname)
-            with open(path, "w", encoding="utf-8") as f:
-                f.write(self._ser(value))
-            del self._mem[key]
-            self._on_disk[key] = fname
+            self._spill_one(next(iter(self._mem)))
 
     def _restore(self, key: Any) -> Any:
         fname = self._on_disk.pop(key)
@@ -130,6 +133,16 @@ class DiskMap(MutableMapping):
             with open(os.path.join(self.dir, fname), "r",
                       encoding="utf-8") as f:
                 yield key, self._de(f.read())
+
+    def demote(self, key: Any) -> bool:
+        """Explicitly page one entry out to disk NOW (hibernate support:
+        the caller wants this entry's RAM back immediately instead of
+        waiting for LRU pressure).  Returns False for unknown keys;
+        already-spilled keys are left alone."""
+        if key not in self._mem:
+            return key in self._on_disk
+        self._spill_one(key)
+        return True
 
     @property
     def n_in_memory(self) -> int:
