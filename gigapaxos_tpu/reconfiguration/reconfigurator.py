@@ -862,6 +862,24 @@ class Reconfigurator:
             self._reply(body, f"{kind}_ack", str(nid), id=nid, ok=False,
                         reason="bad-id")
             return None
+        # a node that cannot own the committed outcome must hand the
+        # request to a live member that can (the create-path primary
+        # forward, applied to membership ops — review find): either it
+        # does not host the record RSM at all (standby, or removed from
+        # the control plane — its propose would silently return None), or
+        # it IS the node a remove targets (it kills its row at phase 2
+        # and never applies RC_NODE_DONE, so its client ack would leak)
+        removes_me = (
+            key_prefix == "#rc" and kind == "remove_reconfigurator"
+            and nid == self.my_id
+        )
+        if self.rc_manager.names.get(RC_GROUP) is None or removes_me:
+            for rc in self._rc_set():
+                if rc == self.my_id or not self.is_node_up(rc):
+                    continue
+                self.send(("RC", int(rc)), kind, body)
+                return None
+            # no live peer to forward to: fall through and try locally
         if body.get("client") is not None:
             self._pending_clients.setdefault(
                 f"{key_prefix}:{kind}:{nid}", []
@@ -952,6 +970,21 @@ class Reconfigurator:
             return
         target = [int(x) for x in nxt["target"]]
         members = sorted(mgr.get_replica_group(RC_GROUP) or [])
+        if fin is None and cur is not None and members != target \
+                and self.my_id in members and mgr.is_stopped(RC_GROUP):
+            # a restart between the stop execution and the epoch switch
+            # lost the in-memory stop-time capture — and a stuck LIVE
+            # first-sorted survivor wedges the whole transition (phase-3
+            # drivers defer to it forever).  Within an epoch the member
+            # set is immutable, so the capture is reconstructible from
+            # the stopped group itself: its row and member set ARE the
+            # stop-time values.
+            row = mgr.epoch_row(RC_GROUP, cur)
+            if row is not None:
+                self._rc_final = fin = {
+                    "from_epoch": int(cur), "row": int(row),
+                    "old": list(members),
+                }
         post = members == target and cur is not None
         if post:
             # phase 3: drive joins, then commit the new set.  The driver

@@ -19,12 +19,9 @@ from gigapaxos_tpu.testing.rc_cluster import ReconfigurableCluster
 
 
 def _wait_ack(c, kind, budget=400):
-    for _ in range(budget):
-        c.step()
-        for k, body in c.drain_client():
-            if k == kind:
-                return body
-    raise AssertionError(f"no {kind} within {budget} steps")
+    body = c.wait_for(kind, max_steps=budget)
+    assert body is not None, f"no {kind} within {budget} steps"
+    return body
 
 
 def _records_agree(c, names, members):
@@ -148,6 +145,89 @@ def test_add_reconfigurator_below_all_members():
                 break
             c.step()
         _records_agree(c, ["low"], members=[0, 1, 2, 3])
+    finally:
+        c.close()
+
+
+def test_add_survives_driver_restart_after_stop():
+    """The first-sorted survivor restarts AFTER executing the epoch-final
+    stop but BEFORE its phase-2 epoch switch, losing the in-memory
+    stop-time capture (``_rc_final``).  Peers defer phase 3 to it forever
+    (it is alive and sorts first), so unless it can reconstruct the
+    capture from its own stopped group, the whole transition wedges
+    (review find).  The member set is immutable within an epoch, which is
+    exactly what makes the reconstruction sound."""
+    c = _make_cluster()
+    try:
+        c.client_request("create_service", {"name": "svc"}, rc=1)
+        assert _wait_ack(c, "create_ack")["ok"]
+
+        # node 0 (first-sorted survivor) drives phase 1 normally, then
+        # "crashes" the instant its stop executes: it never runs phase 2
+        rc0 = c.reconfigurators[0]
+        orig = type(rc0)._advance_rc_transition
+
+        def crashed_after_stop():
+            if c.rcs.managers[0].is_stopped(RC_GROUP):
+                return  # down from the stop execution onward
+            orig(rc0)
+
+        rc0._advance_rc_transition = crashed_after_stop
+
+        c.client_request("add_reconfigurator", {"id": 3}, rc=1)
+        for _ in range(300):
+            c.step()
+            if c.rcs.managers[0].is_stopped(RC_GROUP):
+                break
+        assert c.rcs.managers[0].is_stopped(RC_GROUP)
+
+        # "restart": the in-memory scratch is gone; the durable state
+        # (the stopped group itself) survives
+        rc0._rc_final = None
+        del rc0.__dict__["_advance_rc_transition"]
+
+        body = _wait_ack(c, "add_reconfigurator_ack", budget=800)
+        assert body["ok"] and body["reconfigurators"] == [0, 1, 2, 3], body
+        for _ in range(300):
+            c.step()
+            epochs = [
+                c.rcs.managers[j].current_epoch(RC_GROUP) for j in range(4)
+            ]
+            if epochs == [1, 1, 1, 1]:
+                break
+        assert epochs == [1, 1, 1, 1], epochs
+        for _ in range(400):
+            if c.reconfigurators[3].rc_app.get_record("svc") is not None:
+                break
+            c.step()
+        _records_agree(c, ["svc"], members=[0, 1, 2, 3])
+    finally:
+        c.close()
+
+
+def test_remove_reconfigurator_via_self():
+    """A remove ingressing AT the node being removed, and a later re-add
+    ingressing AT the (now non-member) removed node: both must forward to
+    a live member — the target node never applies RC_NODE_DONE (its ack
+    would leak), and a non-member's propose silently returns None
+    (review finds)."""
+    c = _make_cluster()
+    try:
+        c.client_request("remove_reconfigurator", {"id": 2}, rc=2)
+        body = _wait_ack(c, "remove_reconfigurator_ack", budget=800)
+        assert body["ok"] and body["reconfigurators"] == [0, 1], body
+
+        c.client_request("add_reconfigurator", {"id": 2}, rc=2)
+        body = _wait_ack(c, "add_reconfigurator_ack", budget=800)
+        assert body["ok"] and body["reconfigurators"] == [0, 1, 2], body
+        for _ in range(300):
+            c.step()
+            epochs = [
+                c.rcs.managers[j].current_epoch(RC_GROUP) for j in range(3)
+            ]
+            if epochs == [2, 2, 2]:
+                break
+        assert epochs == [2, 2, 2], epochs
     finally:
         c.close()
 
