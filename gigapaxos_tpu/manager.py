@@ -152,6 +152,10 @@ class Outstanding:
     def put(self, request_id: int, cb: Callable) -> None:
         self._map[request_id] = (time.time(), cb)
 
+    def put_at(self, request_id: int, cb: Callable, t: float) -> None:
+        """put() with a caller-shared timestamp (batched ingress)."""
+        self._map[request_id] = (t, cb)
+
     def pop(self, request_id: int) -> Optional[Callable]:
         ent = self._map.pop(request_id, None)
         return ent[1] if ent else None
@@ -1385,6 +1389,94 @@ class PaxosManager:
     def propose_stop(self, name: str, request_value: str = "", **kw) -> Optional[int]:
         return self.propose(name, request_value, stop=True, **kw)
 
+    def propose_batch(
+        self,
+        items: List[Tuple],
+        entry_replica: Optional[int] = None,
+    ) -> List[Tuple[Optional[int], str, Optional[str]]]:
+        """Batched ingress for a ``client_request_batch`` frame — the
+        proposeBatched analog (``PaxosManager.java:1226``) on the entry
+        side: ONE lock acquisition, one timestamp, and the per-item work
+        stripped to the queue handoff, where the singleton `propose` pays
+        lock+clock+cache-churn per request (at 20k req/s the per-request
+        constant IS the system capacity).
+
+        ``items``: [(name, value, request_id, callback)] — an optional
+        5th element overrides the entry replica per item (forwarded
+        proposals keep their original entry).  Returns
+        [(request_id, outcome, response)]: "queued", "cached" (callback
+        already fired with the response), "inflight" (original still
+        live; callback re-registered), or "unknown" (name not here).
+        Emulation modes take the singleton path (they execute inline)."""
+        if self.emulate_unreplicated or self.lazy_propagation:
+            # singleton path per item (it executes inline); propose()
+            # returns None for BOTH "executed emulated" and "unknown
+            # name", so unknown is detected up front — the batch caller
+            # owes the client an error response for those
+            out = []
+            for item in items:
+                name, value, rid, cb = item[:4]
+                if self.names.get(name) is None:
+                    out.append((rid, "unknown", None))
+                    continue
+                self.propose(
+                    name, value, callback=cb, request_id=rid,
+                    entry_replica=(
+                        item[4] if len(item) > 4 else None
+                    ),
+                )
+                out.append((rid, "emulated", None))
+            return out
+        results: List[Tuple[Optional[int], str, Optional[str]]] = []
+        fired: List[Tuple[Callable, int, Optional[str]]] = []
+        now = time.time()
+        default_entry = self.my_id if entry_replica is None else entry_replica
+        with self._state_lock:
+            versions = self._np("version")
+            names, cache = self.names, self.response_cache
+            inflight, meta = self.inflight, self.vid_meta
+            for item in items:
+                name, value, rid, cb = item[:4]
+                entry = (
+                    item[4] if len(item) > 4 and item[4] is not None
+                    else default_entry
+                )
+                row = names.get(name)
+                if row is None:
+                    results.append((rid, "unknown", None))
+                    continue
+                if rid is not None and rid in cache:
+                    resp = cache[rid][1]
+                    if cb is not None:
+                        fired.append((cb, rid, resp))
+                    results.append((rid, "cached", resp))
+                    continue
+                if rid is not None and inflight.get(rid) in meta:
+                    if cb is not None:
+                        self.outstanding.put(rid, cb)
+                    results.append((rid, "inflight", None))
+                    continue
+                if self._next_counter > VID_COUNTER_MASK:
+                    raise RuntimeError("vid counter space exhausted")
+                vid = (self.my_id << VID_NODE_SHIFT) | self._next_counter
+                self._next_counter += 1
+                if rid is None:
+                    rid = (self._rid_nonce << 24) | (vid & VID_COUNTER_MASK)
+                self.arena[vid] = value
+                meta[vid] = (entry, rid)
+                self.vid_scope[vid] = (name, int(versions[row]))
+                inflight[rid] = vid
+                if cb is not None:
+                    self.outstanding.put_at(rid, cb, now)
+                self.queues.setdefault(row, []).append(vid)
+                self.row_activity[row] = now
+                self.demand_counts[name] = self.demand_counts.get(name, 0) + 1
+                self.demand_backlog += 1
+                results.append((rid, "queued", None))
+        for cb, rid, resp in fired:
+            cb(rid, resp)
+        return results
+
     def overloaded(self) -> bool:
         """Entry back-pressure: too many in-flight requests here."""
         return len(self.inflight) >= self.max_outstanding
@@ -1481,6 +1573,30 @@ class PaxosManager:
                 request_id=body.get("request_id"),
                 entry_replica=body.get("entry", None),
             )
+        elif kind == "forward_batch":
+            # a peer forwards a whole queue run (one frame, many
+            # proposals).  Same staleness guard as singleton forwards.
+            # FIFO within the run is preserved: requests accumulated
+            # before a stop flush BEFORE the stop is proposed (proposing
+            # the stop first would decide it ahead of requests that
+            # preceded it, and the epoch bump would drop them as stale).
+            if self.current_epoch(body["name"]) != int(body["epoch"]):
+                return
+            name = body["name"]
+            items = []
+            for rid, entry, value, stop in body["reqs"]:
+                if stop:
+                    if items:
+                        self.propose_batch(items)
+                        items = []
+                    self.propose(
+                        name, value, stop=True, request_id=rid,
+                        entry_replica=entry,
+                    )
+                else:
+                    items.append((name, value, rid, None, entry))
+            if items:
+                self.propose_batch(items)
         elif kind == "state_request":  # checkpoint-transfer pull
             self._serve_state_request(body)
         elif kind == "state_reply":
@@ -1626,6 +1742,12 @@ class PaxosManager:
                     vids.clear()
                     continue
                 epoch_now = int(self._np("version")[row])
+                # ONE forward_batch frame per row per tick (at capacity a
+                # per-request forward frame was one json encode + syscall
+                # + decode + singleton propose EACH — the non-coordinator
+                # entry's whole budget); the coordinator re-proposes the
+                # list under one lock acquisition
+                reqs = []
                 for vid in vids:
                     # _filter_stale_vids (just above, same lock) guarantees
                     # every kept vid has its payload in the arena
@@ -1635,30 +1757,23 @@ class PaxosManager:
                         # members — the new coordinator re-coalesces them
                         # under its own vid space
                         for rid, entry, value in decode_batch(self.arena[vid]):
-                            self.forward_out.append((coord, "forward", {
-                                "name": name, "value": value, "stop": False,
-                                "request_id": rid, "entry": entry,
-                                "epoch": epoch_now,
-                            }))
-                        self.arena.pop(vid, None)
-                        self.vid_meta.pop(vid, None)
-                        self.vid_scope.pop(vid, None)
-                        continue
-                    entry, rid = self.vid_meta.get(vid, (self.my_id, vid))
-                    self.forward_out.append((coord, "forward", {
-                        "name": name,
-                        "value": self.arena[vid],
-                        "stop": bool(vid & STOP_BIT),
-                        "request_id": rid,
-                        "entry": entry,
-                        "epoch": epoch_now,
-                    }))
+                            reqs.append([rid, entry, value, False])
+                    else:
+                        entry, rid = self.vid_meta.get(vid, (self.my_id, vid))
+                        reqs.append(
+                            [rid, entry, self.arena[vid],
+                             bool(vid & STOP_BIT)]
+                        )
                     # the coordinator re-mints its own vid; our local copy
                     # would only go stale (the callback stays in
                     # self.outstanding keyed by request_id)
                     self.arena.pop(vid, None)
                     self.vid_meta.pop(vid, None)
                     self.vid_scope.pop(vid, None)
+                if reqs:
+                    self.forward_out.append((coord, "forward_batch", {
+                        "name": name, "epoch": epoch_now, "reqs": reqs,
+                    }))
                 vids.clear()
                 continue
             if self.batching_enabled and len(vids) > max(
@@ -1737,6 +1852,7 @@ class PaxosManager:
         out_np_vec = np.asarray(out_vec)  # one transfer; forces the sync
         DelayProfiler.update_delay("engine_step", t0)
         self.last_engine_step_s = time.monotonic() - t0
+        DelayProfiler.update_count("t_engine_step", self.last_engine_step_s)
         out_np = split_out_vec(out_np_vec, cfg)
         host_delta = self._post_step_locked(out_np)
         return np.asarray(blob_vec), new_state, host_delta
@@ -1769,6 +1885,7 @@ class PaxosManager:
         # update_delay takes the START time (it computes monotonic()-t0)
         DelayProfiler.update_delay("engine_step", t0)
         self.last_engine_step_s = time.monotonic() - t0
+        DelayProfiler.update_count("t_engine_step", self.last_engine_step_s)
 
         out_np = jax.tree.map(np.asarray, out)
         host_delta = self._post_step_locked(out_np)
@@ -1891,6 +2008,7 @@ class PaxosManager:
     def _execute(self, out_np) -> None:
         committed = np.nonzero(out_np.n_committed)[0]
         if self.logger is not None and len(committed):
+            t_j = time.monotonic()
             rows, slots, vids = [], [], []
             for g in committed:
                 base = int(out_np.exec_base[g])
@@ -1902,6 +2020,7 @@ class PaxosManager:
                 np.array(rows, np.int32), np.array(slots, np.int32),
                 np.array(vids, np.int32),
             )
+            DelayProfiler.update_count("t_journal", time.monotonic() - t_j)
         if len(committed):
             self.row_activity[committed] = time.time()
         for g in committed:
@@ -1909,7 +2028,12 @@ class PaxosManager:
             pend = self.pending_exec.setdefault(int(g), {})
             for o in range(int(out_np.n_committed[g])):
                 pend[base + o] = int(out_np.exec_vid[g, o])
+        t_exec = time.monotonic()
         missing = self._drain_pending_exec()
+        DelayProfiler.update_delay("app_execute", t_exec)
+        DelayProfiler.update_count(
+            "t_app_execute", time.monotonic() - t_exec
+        )
         if missing:
             self.forward_out.append(
                 (-1, "need_payloads", SyncDecisionsPacket(
@@ -1999,48 +2123,24 @@ class PaxosManager:
             time.sleep(delay)
             delay = min(delay * 2, 0.1)
 
-    def _execute_sub(self, name: Optional[str], request_id: int, entry: int,
-                     value: str) -> None:
-        """Execute ONE client request inside a decided batch, with the
-        same per-request dedup/caching/callback semantics as a singleton
-        decision (the reference's per-sub-request loop in execute(),
-        ``PaxosInstanceStateMachine.java:1647-1689``)."""
-        from .packets.paxos_packets import RequestPacket
-
-        if request_id in self.response_cache:
-            if entry == self.my_id:
-                cb = self.outstanding.pop(request_id)
-                if cb is not None:
-                    self._fired_callbacks.append(
-                        (cb, request_id, self.response_cache[request_id][1])
-                    )
-            return
-        req = SlimRequest(name or "", request_id, value)
-        self._app_execute_retrying(req, do_not_reply=(entry != self.my_id))
-        self.total_executed += 1
-        self.inflight.pop(request_id, None)
-        response = getattr(req, "response_value", None)
-        self._cache_response(request_id, response, name or "")
-        if entry == self.my_id:
-            cb = self.outstanding.pop(request_id)
-            if cb is not None:
-                self._fired_callbacks.append((cb, request_id, response))
-
     def _cache_response(self, request_id: int, response: Optional[str],
                         name: str) -> None:
         self.response_cache[request_id] = (time.time(), response, name)
         if len(self.response_cache) > self.response_cache_cap:
-            # size bound (RESPONSE_CACHE_SIZE analog): evict the oldest
-            # tenth so the cache (and its state-transfer ride-along)
-            # stays bounded under sustained load between checkpoint GCs.
-            # Eviction is per-node (like the reference's time+size-GC'd
-            # GCConcurrentHashMap): exactly-once is guaranteed within the
-            # TTL/size window, not beyond it
-            by_age = sorted(
-                self.response_cache.items(), key=lambda kv: kv[1][0]
-            )
-            for rid, _ in by_age[: max(1, len(by_age) // 10)]:
-                del self.response_cache[rid]
+            self._evict_response_cache()
+
+    def _evict_response_cache(self) -> None:
+        """Size bound (RESPONSE_CACHE_SIZE analog): evict the oldest
+        tenth so the cache (and its state-transfer ride-along) stays
+        bounded under sustained load between checkpoint GCs.  Eviction
+        is per-node (like the reference's time+size-GC'd
+        GCConcurrentHashMap): exactly-once is guaranteed within the
+        TTL/size window, not beyond it."""
+        by_age = sorted(
+            self.response_cache.items(), key=lambda kv: kv[1][0]
+        )
+        for rid, _ in by_age[: max(1, len(by_age) // 10)]:
+            del self.response_cache[rid]
 
     def _execute_one(self, name: Optional[str], g: int, slot: int, vid: int) -> bool:
         if vid == 0:  # NOOP hole-filler: nothing to execute
@@ -2054,9 +2154,37 @@ class PaxosManager:
             # replica decodes the same payload in the same order, and the
             # per-sub-request dedup decision is deterministic across the
             # group (same decided sequence, same earlier executions), so
-            # the RSM stays convergent.
+            # the RSM stays convergent.  Hot loop: the clock and the
+            # cache size-bound check amortize once per BATCH (at 2000
+            # sub-requests/slot the per-request constants here are the
+            # replica's whole execution budget).
+            now = time.time()
+            rc = self.response_cache
+            nm = name or ""
+            my = self.my_id
             for request_id, entry, value in decode_batch(payload):
-                self._execute_sub(name, request_id, entry, value)
+                if request_id in rc:
+                    if entry == my:
+                        cb = self.outstanding.pop(request_id)
+                        if cb is not None:
+                            self._fired_callbacks.append(
+                                (cb, request_id, rc[request_id][1])
+                            )
+                    continue
+                req = SlimRequest(nm, request_id, value)
+                self._app_execute_retrying(req, do_not_reply=(entry != my))
+                self.total_executed += 1
+                self.inflight.pop(request_id, None)
+                response = req.response_value
+                rc[request_id] = (now, response, nm)
+                if entry == my:
+                    cb = self.outstanding.pop(request_id)
+                    if cb is not None:
+                        self._fired_callbacks.append(
+                            (cb, request_id, response)
+                        )
+            if len(rc) > self.response_cache_cap:
+                self._evict_response_cache()
             self._slots_since_ckpt += 1
             self.retained[vid] = (g, slot)
             return True

@@ -37,6 +37,7 @@ from .net.transport import MessageTransport
 from .ops.engine import EngineConfig
 from .paxos_config import PC
 from .utils.config import Config
+from .utils.profiler import DelayProfiler
 
 
 class PaxosServer:
@@ -222,7 +223,7 @@ class PaxosServer:
         """JSON-frame dispatch; subclasses extend (ReconfigurableNode roles
         layer epoch-plane kinds on the same demux — the reference's
         precedePacketDemultiplexer chaining).  Returns True if handled."""
-        if k in ("payloads", "forward", "need_payloads",
+        if k in ("payloads", "forward", "forward_batch", "need_payloads",
                  "state_request", "state_reply"):
             self.manager.on_host_message(k, body)
         elif k == "chunk":
@@ -235,9 +236,9 @@ class PaxosServer:
         elif k == "client_request_batch":
             # many requests in one frame (client-side coalescing; the
             # nested `batched` RequestPacket array on the wire,
-            # RequestPacket.java:189-246)
-            for sub in body.get("reqs", ()):
-                self._on_client_request(sub, reply)
+            # RequestPacket.java:189-246) — proposed as ONE batched
+            # manager call, not per sub-request
+            self._on_client_batch(body.get("reqs", ()), reply)
             self._flush_responses()
         elif k == "admin":
             self._on_admin(body, reply)
@@ -322,6 +323,7 @@ class PaxosServer:
             if not self._resp_buf:
                 return
             bufs, self._resp_buf = self._resp_buf, {}
+        t0 = time.monotonic()
         for reply, items in bufs.values():
             if len(items) == 1:
                 reply(encode_json("client_response", self.my_id, items[0]))
@@ -329,8 +331,57 @@ class PaxosServer:
                 reply(encode_json(
                     "client_response_batch", self.my_id, {"resps": items}
                 ))
+        DelayProfiler.update_count("t_flush", time.monotonic() - t0)
 
     def _on_client_request(self, body: Dict, reply) -> None:
+        t0 = time.monotonic()
+        try:
+            self._on_client_request_inner(body, reply)
+        finally:
+            DelayProfiler.update_count(
+                "t_ingress", time.monotonic() - t0
+            )
+
+    def _on_client_batch(self, reqs, reply) -> None:
+        """Batched-frame ingress: one propose_batch call for the whole
+        frame (stops and overload shedding peel off to the singleton
+        path; everything else amortizes the lock/clock per frame)."""
+        t0 = time.monotonic()
+        m = self.manager
+        overloaded = m.overloaded()
+        items = []
+        for sub in reqs:
+            if sub.get("stop"):
+                self._on_client_request_inner(sub, reply)
+                continue
+            request_id = int(sub["request_id"])
+            name = sub["name"]
+            if overloaded and request_id not in m.response_cache:
+                self._buffer_response(reply, {
+                    "request_id": request_id, "response": None,
+                    "name": name, "error": "overload",
+                })
+                continue
+
+            def cb(rid, response, _name=name):
+                self._buffer_response(reply, {
+                    "request_id": rid, "response": response, "name": _name,
+                })
+
+            items.append((name, sub.get("value", ""), request_id, cb))
+        if items:
+            results = m.propose_batch(items)
+            for (name, _v, _r, _cb), (rid, outcome, _resp) in zip(
+                items, results
+            ):
+                if outcome == "unknown":
+                    self._buffer_response(reply, {
+                        "request_id": rid, "response": None,
+                        "name": name, "error": "unknown_name",
+                    })
+        DelayProfiler.update_count("t_ingress", time.monotonic() - t0)
+
+    def _on_client_request_inner(self, body: Dict, reply) -> None:
         request_id = int(body["request_id"])
         name = body["name"]
         if self.manager.overloaded() and \
@@ -354,7 +405,12 @@ class PaxosServer:
             callback=cb, stop=bool(body.get("stop", False)),
             request_id=request_id,
         )
-        if vid is None and request_id not in self.manager.response_cache:
+        if vid is None and request_id not in self.manager.response_cache \
+                and self.manager.names.get(name) is None:
+            # None + uncached + hosted here means the original proposal
+            # is still in flight (callback re-registered) — only an
+            # UNHOSTED name is a real error; erroring the inflight case
+            # double-answers the client (batch-path parity)
             self._buffer_response(reply, {
                 "request_id": request_id, "response": None,
                 "name": name, "error": "unknown_name",
@@ -433,6 +489,13 @@ class PaxosServer:
         self._flush_responses()
 
     def tick_once(self) -> None:
+        t0 = time.monotonic()
+        try:
+            self._tick_once_inner()
+        finally:
+            DelayProfiler.update_count("t_tick", time.monotonic() - t0)
+
+    def _tick_once_inner(self) -> None:
         R = self.cfg.n_replicas
         # packed exchange: peer frames already ARE the [N] vectors, my
         # previous tick's publish vector is cached, and the whole [R, N]
@@ -492,6 +555,7 @@ class PaxosServer:
         # (e.g. consuming a straggler's blobs) would otherwise never
         # republish and the straggler could not heal from it
         peers = [r for r in self.node_config.get_node_ids() if r != self.my_id]
+        t_pub = time.monotonic()
         if progressed or self._in_flight or (
             time.monotonic() - self._last_publish > self.IDLE_REPUBLISH_S
         ):
@@ -503,6 +567,7 @@ class PaxosServer:
             frame = encode_json("payloads", self.my_id, delta)
             for r in peers:
                 self.transport.send_to_id(r, frame)
+        DelayProfiler.update_count("t_publish", time.monotonic() - t_pub)
         fwd = self.manager.drain_forward_out()
         for dst, k, body in fwd:
             frame = encode_json(k, self.my_id, body)
@@ -516,8 +581,10 @@ class PaxosServer:
             else:
                 self.send_frame_to_id(dst, frame)
 
+        t_layer = time.monotonic()
         self._maybe_ping()
         self._layer_tick()
+        DelayProfiler.update_count("t_layer", time.monotonic() - t_layer)
         self._flush_responses()  # callbacks fired by this tick's execution
 
     def _maybe_ping(self) -> None:

@@ -170,3 +170,28 @@ def test_batch_survives_crash_recovery(tmp_path):
     assert m.app.state.get("r") == states[0]
     assert m.app.n_executed.get("r") == 60
     m.close()
+
+
+def test_forward_batch_preserves_fifo_around_stop():
+    """A non-coordinator entry forwards its whole queue run as ONE
+    forward_batch frame; requests queued BEFORE a stop must commit
+    before it (proposing the stop first would bump the epoch and drop
+    them as stale — review find on the batched forward path)."""
+    cfg = small_cfg()
+    c = ManagerCluster(cfg, HashChainApp)
+    c.create("f", members=[0, 1, 2])
+    row = c.managers[0].names["f"]
+    coord = c.managers[0].coordinator_of_row(row)
+    entry = (coord + 1) % 3  # a NON-coordinator entry replica
+    for i in range(5):
+        c.submit("f", f"pre{i}", entry=entry)
+    c.submit("f", "", entry=entry, stop=True)
+    c.run(20)
+    for m in c.managers:
+        # all five pre-stop requests executed (the chain advanced 5+ --
+        # the stop itself also chains), and the group is stopped
+        assert m.app.n_executed.get("f", 0) >= 5, m.app.n_executed
+        assert int(np.asarray(m.state.stopped)[row]) == 1
+    states = {m.app.state.get("f") for m in c.managers}
+    assert len(states) == 1
+    c.close()
