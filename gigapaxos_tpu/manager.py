@@ -2225,8 +2225,19 @@ class PaxosManager:
         return True
 
     # ------------------------------------------------------------------
-    # checkpoint transfer for stragglers (StatePacket / handleCheckpoint,
-    # PaxosInstanceStateMachine.java:1744; jumpSlot, PaxosAcceptor.java:538)
+    # THE data-plane straggler sync protocol — the one heal path for
+    # every way a member falls behind, mirroring the reference's single
+    # sync state machine (detect stall -> request missing decisions ->
+    # checkpoint transfer if too far behind,
+    # PaxosInstanceStateMachine.java:2161-2340; StatePacket /
+    # handleCheckpoint:1744; jumpSlot, PaxosAcceptor.java:538).  Missing
+    # DECISIONS within the window heal through the blob rings + payload
+    # pulls (need_payloads); everything beyond heals here: detection
+    # (_maybe_request_state) -> state_request to a rotated donor ->
+    # _apply_state_reply (full checkpoint jump, small-gap jump once
+    # provably stalled, or app-cursor adoption).  The control-plane
+    # sibling for stranded EPOCH forms (pause records, pending rows) is
+    # the reconfigurator's epoch_probe.
     # ------------------------------------------------------------------
     STATE_REQ_INTERVAL = 16  # ticks between pulls for the same row
     PAYLOAD_BLOCKED_TICKS = 64  # parked-on-missing-payload pull trigger

@@ -149,17 +149,18 @@ class ActiveReplica:
             self._handle_epoch_commit(body)
         elif kind == "pause_epoch":
             self._handle_pause_epoch(body)
-        elif kind == "pause_drop":
-            # RC says this pause record is obsolete (name deleted or the
-            # epoch moved past it): GC it
-            self.coordinator.drop_pause_record(
-                body["name"], int(body["epoch"])
-            )
-        elif kind == "pending_drop":
-            # RC says this pending row's epoch is gone: free it
-            self.coordinator.drop_pending_row(
-                body["name"], int(body["epoch"]), int(body["row"])
-            )
+        elif kind == "epoch_gone":
+            # RC's answer to an epoch_probe: the probed (name, epoch) is
+            # obsolete — GC whichever stranded form this member holds (a
+            # pause record, or a row stuck behind the admission gate)
+            if body.get("row") is not None:
+                self.coordinator.drop_pending_row(
+                    body["name"], int(body["epoch"]), int(body["row"])
+                )
+            else:
+                self.coordinator.drop_pause_record(
+                    body["name"], int(body["epoch"])
+                )
 
     def tick(self, now: Optional[float] = None) -> None:
         self.tasks.tick(now)
@@ -200,52 +201,40 @@ class ActiveReplica:
         if now - self._last_sweep < period:
             return
         self._last_sweep = now
-        # probe held pause records (chaos find: an aborted pause round
-        # leaves this member FROZEN while the record stays live — if it
-        # is the group's ballot coordinator, the whole group wedges; the
-        # RC answers with a committed resume, silence, or a drop).
+        # ONE probe protocol for every stranded-epoch form (chaos finds,
+        # unified): a held pause record after an aborted pause round
+        # (row=None), or a row stuck behind the pre-COMPLETE admission
+        # gate after its late-start retransmits expired (row=int).  Both
+        # ask the RC "where does (name, epoch) really live?"; the RC
+        # answers with a committed resume / an epoch_commit re-send /
+        # epoch_gone / silence (holding is right).
         # NOT gated by pause_option: records can predate a config change,
         # and healing them is unrelated to whether we SUGGEST new pauses.
-        # Per-record EXPONENTIAL BACKOFF (up to 16 periods): long-paused
+        # Per-key EXPONENTIAL BACKOFF (up to 16 periods): long-paused
         # groups are the normal steady state at residency scale, and
         # re-asking about each of them every period would cost
         # O(paused * members) control traffic forever.
-        pause_keys = set(self.coordinator.pause_record_keys())
-        pending_keys = list(self.coordinator.pending_row_keys())
-        live = pause_keys | {
-            ("pending", n, e, r) for n, e, r in pending_keys
-        }
+        probes = [
+            (n, int(e), None) for n, e in self.coordinator.pause_record_keys()
+        ] + [
+            (n, int(e), int(r))
+            for n, e, r in self.coordinator.pending_row_keys()
+        ]
+        live = set(probes)
         for k in [k for k in self._probe_backoff if k not in live]:
             del self._probe_backoff[k]
-        for name, epoch in pause_keys:
-            ent = self._probe_backoff.get((name, epoch))
-            if ent is not None and ent[0] > now:
-                continue
-            interval = min(
-                (ent[1] * 2) if ent else period, period * 16
-            )
-            self._probe_backoff[(name, epoch)] = (now + interval, interval)
-            rc = self.rc_ids[hash(name) % len(self.rc_ids)]
-            self.send(("RC", rc), "pause_probe", {
-                "name": name, "epoch": int(epoch), "from": self.my_id,
-            })
-        # probe rows stuck pre-COMPLETE (same heal family: a member
-        # stranded at a LOSING probe row after its late-start expired
-        # refuses every proposal forever — and the commit round that
-        # would heal it already completed on the others, so nothing
-        # re-drives it)
-        for name, epoch, row in pending_keys:
-            key = ("pending", name, epoch, row)
+        for key in probes:
             ent = self._probe_backoff.get(key)
             if ent is not None and ent[0] > now:
                 continue
             interval = min((ent[1] * 2) if ent else period, period * 16)
             self._probe_backoff[key] = (now + interval, interval)
-            rc = self.rc_ids[hash(name) % len(self.rc_ids)]
-            self.send(("RC", rc), "pending_probe", {
-                "name": name, "epoch": int(epoch), "row": int(row),
-                "from": self.my_id,
-            })
+            name, epoch, row = key
+            body = {"name": name, "epoch": epoch, "from": self.my_id}
+            if row is not None:
+                body["row"] = row
+            self.send(("RC", self.rc_ids[hash(name) % len(self.rc_ids)]),
+                      "epoch_probe", body)
         if not self.pause_option:
             return
         for name, epoch in self.coordinator.idle_groups(period):

@@ -569,10 +569,8 @@ class Reconfigurator:
             self.tasks.handle_event(f"pause:{body['name']}", kind, body)
         elif kind == "suggest_pause":
             self._handle_suggest_pause(body)
-        elif kind == "pause_probe":
-            self._handle_pause_probe(body)
-        elif kind == "pending_probe":
-            self._handle_pending_probe(body)
+        elif kind == "epoch_probe":
+            self._handle_epoch_probe(body)
         elif kind == "reactivate_service":
             self.kick_reactivate(body["name"])
         elif kind == "demand_report":
@@ -1183,82 +1181,57 @@ class Reconfigurator:
             "rc": ["RC", self.my_id],
         })
 
-    def _handle_pause_probe(self, body: Dict) -> None:
-        """A member holding a local pause record for (name, epoch) asks
-        what to do with it (chaos-soak find: a pause round that aborted
-        after SOME members froze leaves them holding pause records while
-        the record stays live — a frozen ballot coordinator wedges its
-        whole group, and nothing else ever heals it because the node
-        still answers pings and remains in the member mask).
+    def _handle_epoch_probe(self, body: Dict) -> None:
+        """THE stranded-member heal protocol: a member asks where
+        (name, epoch) really lives.  One handler for every stranded form
+        the chaos soak has produced — a held pause record after an
+        aborted pause round (no ``row``: a frozen ballot coordinator
+        wedges its whole group, and nothing else heals it because it
+        still answers pings and stays in the member mask), or a row
+        stuck behind the pre-COMPLETE admission gate after its
+        late-start retransmits expired (``row``: a member stranded at a
+        LOSING probe row refuses every proposal forever, and the commit
+        round that would heal it already completed on the others).
 
-        Answers: committed resume (record live at this epoch and the
-        prober is a member — rejoin in place), pause_drop (name deleted
-        or the epoch superseded — GC the record), or silence (record
-        PAUSED: holding the record is exactly right)."""
+        Answers: an epoch_commit re-send when the prober's row IS the
+        winning one (only its confirm was lost); a committed resume
+        (rejoin in place / re-home to the winning row); epoch_gone when
+        the probed epoch is deleted or superseded (GC whatever the
+        prober holds); or silence while another round owns the record —
+        the mirror of the reference's one sync protocol for stragglers
+        (``PaxosInstanceStateMachine.java:2161-2340``), applied to the
+        control plane."""
         name, epoch = body["name"], int(body["epoch"])
+        row = body.get("row")
         frm = int(body["from"])
         if not self.is_primary(name):
-            self.send(("RC", self.primary_of(name)), "pause_probe", body)
+            self.send(("RC", self.primary_of(name)), "epoch_probe", body)
             return
+        gone = {"name": name, "epoch": epoch}
+        if row is not None:
+            gone["row"] = int(row)
         rec = self.rc_app.get_record(name)
         if rec is None or rec.deleted or rec.epoch > epoch:
-            self.send(("AR", frm), "pause_drop",
-                      {"name": name, "epoch": epoch})
+            self.send(("AR", frm), "epoch_gone", gone)
             return
         if rec.epoch != epoch:
             return  # prober lags the record; other machinery owns it
         if rec.state not in (RCState.READY, RCState.WAIT_ACK_STOP):
-            # PAUSED/WAIT_PAUSE: holding the record is right.  WAIT_ACK_
-            # START/reactivation: the row is still a PROBE — a committed
-            # resume there would bypass the pending gate and wedge the
-            # row-collision machinery.  WAIT_DELETE: deletion owns it.
-            # READY and WAIT_ACK_STOP both have a SETTLED committed row,
-            # and the frozen member is needed live (under WAIT_ACK_STOP
-            # the stop round cannot commit without it — the original
-            # wedge shape this probe exists for).
+            # PAUSED/WAIT_PAUSE: holding a pause record is right.
+            # WAIT_ACK_START/reactivation: the row is still a PROBE — a
+            # committed resume there would bypass the pending gate and
+            # wedge the row-collision machinery.  WAIT_DELETE: deletion
+            # owns it.  READY and WAIT_ACK_STOP both have a SETTLED
+            # committed row, and the stranded member is needed live
+            # (under WAIT_ACK_STOP the stop round cannot commit without
+            # it — the original wedge shape this probe exists for).
             return
         if frm not in rec.actives or rec.row < 0:
-            # the live epoch moved on without this member; its snapshot
-            # is superseded by the epoch machinery's state transfer
-            self.send(("AR", frm), "pause_drop",
-                      {"name": name, "epoch": epoch})
+            # the live epoch moved on without this member; its local
+            # leftovers are superseded by the epoch state transfer
+            self.send(("AR", frm), "epoch_gone", gone)
             return
-        # live record, frozen member: rejoin in place
-        self.send_committed_resume(
-            frm, name, rec.epoch, rec.actives, rec.row, rec.initial_state
-        )
-
-    def _handle_pending_probe(self, body: Dict) -> None:
-        """A member whose row is stuck behind the pre-COMPLETE admission
-        gate asks where the epoch really lives (chaos-soak find: a member
-        stranded at a LOSING probe row after its late-start retransmits
-        expired refuses every proposal forever, and the commit round that
-        would heal it already completed on the other members).
-
-        Answers: a direct epoch_commit re-send when the member's row IS
-        the winning one (its confirm was lost), a committed resume at the
-        winning row when it is stuck at a loser, pending_drop when the
-        epoch is gone, or silence while the start round still owns the
-        row probe."""
-        name, epoch = body["name"], int(body["epoch"])
-        row, frm = int(body["row"]), int(body["from"])
-        if not self.is_primary(name):
-            self.send(("RC", self.primary_of(name)), "pending_probe", body)
-            return
-        rec = self.rc_app.get_record(name)
-        if rec is None or rec.deleted or rec.epoch > epoch:
-            self.send(("AR", frm), "pending_drop",
-                      {"name": name, "epoch": epoch, "row": row})
-            return
-        if rec.epoch != epoch or rec.state not in (
-            RCState.READY, RCState.WAIT_ACK_STOP,
-        ):
-            return  # start round / pause / delete machinery owns it
-        if frm not in rec.actives or rec.row < 0:
-            self.send(("AR", frm), "pending_drop",
-                      {"name": name, "epoch": epoch, "row": row})
-            return
-        if rec.row == row:
+        if row is not None and rec.row == int(row):
             # the member holds the WINNING row; only its confirm was lost
             self.send(("AR", frm), "epoch_commit", {
                 "name": name, "epoch": epoch, "row": rec.row,
@@ -1266,6 +1239,7 @@ class Reconfigurator:
                 "rc": ["RC", self.my_id],
             })
         else:
+            # stranded member of a live epoch: rejoin at the winning row
             self.send_committed_resume(
                 frm, name, rec.epoch, rec.actives, rec.row,
                 rec.initial_state,
