@@ -24,7 +24,11 @@ from gigapaxos_tpu.testing.chaos import run_soak
 
 _SEEDS = (
     [int(os.environ["CHAOS_SEED"])] if os.environ.get("CHAOS_SEED")
-    else [1234, 7, 20260730]
+    # 1280113 / 777063353: the r5 offline sweep's two liveness-wedge
+    # shapes (a READY record with one member hosting nothing; a
+    # WAIT_ACK_STOP migration that never settled) — pinned so the
+    # shapes stay covered even though they no longer reproduce on HEAD
+    else [1234, 7, 20260730, 1280113, 777063353]
 )
 
 
