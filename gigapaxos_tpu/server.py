@@ -541,6 +541,11 @@ class PaxosServer:
         # refreshed HERE (post-engine): gates blob-kick wakeups and the
         # idle skip until the next tick updates it
         self._in_flight = m.engine_work_in_flight()
+        DelayProfiler.update_count("n_ticks")
+        if not progressed:
+            DelayProfiler.update_count("n_ticks_noprog")
+            if self._in_flight:
+                DelayProfiler.update_count("n_ticks_inflight_noprog")
 
         # publish: blob to every peer (the all_gather stand-in).  Gated:
         # publishing from a tick that neither progressed nor has work in
