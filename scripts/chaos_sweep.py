@@ -30,6 +30,9 @@ def main() -> None:
     ap.add_argument("--stride", type=int, default=7919)
     ap.add_argument("--budget-s", type=float, default=None,
                     help="stop starting new seeds after this much wall time")
+    ap.add_argument("--rounds", type=int, default=60)
+    ap.add_argument("--names", type=int, default=6)
+    ap.add_argument("--loss", type=float, default=0.2)
     args = ap.parse_args()
 
     fails = []
@@ -39,7 +42,8 @@ def main() -> None:
         seed = args.base + i * args.stride
         t = time.time()
         try:
-            run_soak(seed)
+            run_soak(seed, rounds=args.rounds, n_names=args.names,
+                     loss=args.loss)
             print(f"[{i}] seed={seed} OK {time.time() - t:.1f}s", flush=True)
         except Exception as e:
             print(f"[{i}] seed={seed} FAIL {time.time() - t:.1f}s: {e}",
