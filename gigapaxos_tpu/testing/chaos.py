@@ -105,8 +105,17 @@ def run_soak(
     rc_cfg: Optional[EngineConfig] = None,
     settle_budget_s: float = 420.0,
     loss: float = 0.2,
+    dup_rate: float = 0.0,
 ) -> Dict:
     """Run one seeded soak; raises :class:`SoakDivergence` on violation.
+
+    ``dup_rate``: probability that a traffic round re-proposes a PAST
+    request id (same id+value, random entry replica) instead of a fresh
+    request — the client-retransmit stressor that hunts lost dedup
+    entries across blank-join/resume/state-pull handoffs (a member
+    missing the entry re-executes the duplicate and diverges the RSM;
+    ref exactly-once semantics ``PaxosManager.java:318-346``).  Default
+    0 keeps the historical pinned-seed schedules byte-identical.
 
     Returns a small stats dict (rounds run, settle iterations) on success.
     """
@@ -160,12 +169,21 @@ def run_soak(
         for _ in range(40):
             step()
 
+        history = []  # (name, request_id, value) of every injected request
+        rid_base = (1 << 55) + seed % (1 << 20)
         for round_no in range(rounds):
             op = rng.random()
             nm = rng.choice(names)
-            if op < 0.35:  # traffic
+            if op < 0.35:  # traffic (fresh, or a duplicate retransmit)
                 entry = rng.randrange(n_ar)
-                c.ars.managers[entry].propose(nm, f"r{round_no}")
+                if dup_rate and history and rng.random() < dup_rate:
+                    dn, rid, val = history[rng.randrange(len(history))]
+                    c.ars.managers[entry].propose(dn, val, request_id=rid)
+                else:
+                    rid = rid_base + round_no
+                    val = f"r{round_no}"
+                    c.ars.managers[entry].propose(nm, val, request_id=rid)
+                    history.append((nm, rid, val))
             elif op < 0.55:  # migrate to a random 3-set
                 target = rng.sample(range(n_ar), 3)
                 c.client_request(

@@ -33,6 +33,7 @@ def main() -> None:
     ap.add_argument("--rounds", type=int, default=60)
     ap.add_argument("--names", type=int, default=6)
     ap.add_argument("--loss", type=float, default=0.2)
+    ap.add_argument("--dup-rate", type=float, default=0.0)
     args = ap.parse_args()
 
     fails = []
@@ -43,7 +44,7 @@ def main() -> None:
         t = time.time()
         try:
             run_soak(seed, rounds=args.rounds, n_names=args.names,
-                     loss=args.loss)
+                     loss=args.loss, dup_rate=args.dup_rate)
             print(f"[{i}] seed={seed} OK {time.time() - t:.1f}s", flush=True)
         except Exception as e:
             print(f"[{i}] seed={seed} FAIL {time.time() - t:.1f}s: {e}",

@@ -58,6 +58,29 @@ def test_chaos_fresh_seeds():
         ran += 1
 
 
+def test_chaos_duplicate_retransmits():
+    """Fresh-seed soak with CLIENT-RETRANSMIT injection (dup_rate=0.3):
+    a quarter of traffic rounds re-propose a past request id through a
+    random entry — the direct stressor for dedup entries lost across
+    blank-join/resume/state-pull handoffs (the r4 open-issue shape).  A
+    member missing the entry re-executes the duplicate; the per-step
+    probe catches the divergence at birth."""
+    budget = float(os.environ.get("CHAOS_DUP_BUDGET_S", "60"))
+    base = (int(time.time()) + 7919) % 1_000_000_007
+    deadline = time.time() + budget
+    ran = 0
+    while ran == 0 or time.time() < deadline:
+        seed = base + ran * 104729
+        try:
+            run_soak(seed, dup_rate=0.3)
+        except Exception as e:
+            raise AssertionError(
+                f"duplicate-retransmit soak FAILED at seed={seed} "
+                f"(reproduce: run_soak({seed}, dup_rate=0.3))"
+            ) from e
+        ran += 1
+
+
 def test_chaos_large_shape():
     """One soak at a bigger deployment shape: more groups, wider window,
     5 replicas, more adversarial rounds."""
