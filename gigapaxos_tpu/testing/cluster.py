@@ -56,6 +56,13 @@ class ManagerCluster:
             FailureDetector(r, range(R), timeout_s=float("inf"))
             for r in range(R)
         ]
+        # same reasoning as the infinite FD timeout above: stepped
+        # clusters run on LOGICAL time, but the client-callback GC is
+        # wall-clock — on a loaded box (cold jax compiles, CI
+        # contention) a single tick can outlive the 8s client TTL and
+        # silently reap every callback a test is counting
+        for m in self.managers:
+            m.outstanding.timeout_s = float("inf")
 
     # ---- lifecycle across the cluster ---------------------------------
     def create(self, name: str, members: Optional[List[int]] = None,

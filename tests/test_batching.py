@@ -195,3 +195,34 @@ def test_forward_batch_preserves_fifo_around_stop():
     states = {m.app.state.get("f") for m in c.managers}
     assert len(states) == 1
     c.close()
+
+
+def test_propose_batch_outcomes():
+    """The batched ingress reports the same per-request outcomes the
+    singleton path implements: queued, cached (callback fired from the
+    response cache), inflight (callback re-registered), unknown."""
+    cfg = EngineConfig(n_groups=4, window=8, req_lanes=4, n_replicas=1)
+    c = ManagerCluster(cfg, HashChainApp)
+    m = c.managers[0]
+    c.create("b", members=[0])
+    rid = 1 << 56
+    got = []
+    res = m.propose_batch([
+        ("b", "v0", rid, lambda r, resp: got.append(resp)),
+        ("nope", "v1", rid + 1, None),
+    ])
+    assert [r[1] for r in res] == ["queued", "unknown"]
+
+    # same id again while the original is still undecided -> inflight
+    res = m.propose_batch([("b", "v0", rid, lambda r, resp: got.append(resp))])
+    assert res[0][1] == "inflight"
+
+    c.run(8)  # decide + execute
+    assert got, "callback never fired"
+    first_resp = got[-1]
+
+    # after execution the id answers from the cache, callback fires
+    res = m.propose_batch([("b", "v0", rid, lambda r, resp: got.append(resp))])
+    assert res[0][1] == "cached" and res[0][2] == first_resp
+    assert got[-1] == first_resp
+    c.close()
