@@ -437,6 +437,13 @@ class PaxosServer:
             reply(encode_json("admin_response", self.my_id, {
                 "op": op, "name": body["name"], "ok": bool(ok),
             }))
+        elif op in ("hibernate", "restore"):
+            # checkpoint-and-sleep / local wake-up (PaxosManager.java:
+            # 2209-2252) — node-local ops, like the reference's
+            ok = getattr(self.manager, op)(body["name"])
+            reply(encode_json("admin_response", self.my_id, {
+                "op": op, "name": body["name"], "ok": bool(ok),
+            }))
 
     # ---- the tick loop -------------------------------------------------
     def _run(self) -> None:

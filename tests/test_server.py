@@ -82,6 +82,36 @@ def test_loopback_1_group_end_to_end():
 
 
 @pytest.mark.timeout(180)
+def test_hibernate_restore_over_sockets():
+    """hibernate/restore as deployed admin ops: checkpoint-and-sleep on
+    every node over the wire, wake locally, traffic resumes on the
+    restored state (PaxosManager.java:2209-2252 reachable end-to-end)."""
+    servers, client, _ = boot_cluster()
+    try:
+        assert client.create_paxos_instance("hib", [0, 1, 2], timeout=30)
+        assert client.send_request_sync("hib", "5", timeout=30) == "5"
+        for s in range(3):
+            r = client.admin_sync(
+                s, {"op": "hibernate", "name": "hib"}, timeout=30
+            )
+            assert r and r.get("ok"), r
+        assert all(srv.manager.names.get("hib") is None for srv in servers)
+        for s in range(3):
+            r = client.admin_sync(
+                s, {"op": "restore", "name": "hib"}, timeout=30
+            )
+            assert r and r.get("ok"), r
+        assert client.send_request_sync("hib", "2", timeout=30) == "7"
+        assert wait_until(lambda: all(
+            srv.manager.app.totals.get("hib") == 7 for srv in servers
+        ))
+    finally:
+        client.close()
+        for s in servers:
+            s.stop()
+
+
+@pytest.mark.timeout(180)
 def test_coordinator_failover_over_sockets():
     servers, client, ports = boot_cluster(fd_timeout_s=1.0)
     try:
