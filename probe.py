@@ -44,6 +44,9 @@ def main() -> int:
                     help="EMULATE_UNREPLICATED attribution mode "
                          "(PaxosManager.java:1731): answer at the entry "
                          "without consensus, isolating app+wire cost")
+    ap.add_argument("--durable", action="store_true",
+                    help="in-process nodes journal to disk (native "
+                         "group-commit path under full system load)")
     ap.add_argument("--in-process", action="store_true",
                     help="all nodes in this process (default: one OS "
                          "process per node — the realistic deployment "
@@ -92,8 +95,16 @@ def main() -> int:
         )
         rc_cfg = EngineConfig(n_groups=64, window=16, req_lanes=8,
                               n_replicas=3)  # match the child default
+        log_root = None
+        if args.durable:
+            import tempfile
+
+            log_root = tempfile.mkdtemp(prefix="gp_probe_journal_")
         nodes = [
-            ReconfigurableNode(n, NoopPaxosApp, ar_cfg=ar_cfg, rc_cfg=rc_cfg)
+            ReconfigurableNode(
+                n, NoopPaxosApp, ar_cfg=ar_cfg, rc_cfg=rc_cfg,
+                log_dir=(f"{log_root}/{n}" if log_root else None),
+            )
             for n in node_names
         ]
         for n in nodes:
