@@ -1536,9 +1536,13 @@ class PaxosManager:
                 # only the admitting coordinator persisted them, a
                 # coordinator-only crash could lose decided-but-unexecuted
                 # values for everyone
+                t_lp = time.monotonic()
                 self.logger.log_payloads(fresh, meta={
                     k: self.vid_meta[k] for k in fresh if k in self.vid_meta
                 })
+                DelayProfiler.update_count(
+                    "t_log_payloads", time.monotonic() - t_lp
+                )
             ae = body.get("app_exec")
             if ae is not None:
                 rid, cursors = ae
@@ -2508,6 +2512,12 @@ class PaxosManager:
     def checkpoint_now(self) -> None:
         if self.logger is None:
             return
+        t_ck = time.monotonic()
+        self._checkpoint_now_inner()
+        DelayProfiler.update_delay("checkpoint", t_ck)
+        DelayProfiler.update_count("t_checkpoint", time.monotonic() - t_ck)
+
+    def _checkpoint_now_inner(self) -> None:
         arrays = {k: np.asarray(v) for k, v in self.state._asdict().items()}
         app_states = {
             name: self.app.checkpoint(name) for name in self.names
@@ -2520,8 +2530,13 @@ class PaxosManager:
         # can trail the device frontier when payloads are in flight; the
         # in-between (slot -> vid) map rides along so recovery resumes
         # execution exactly where the app state string left off.
-        self.logger.checkpoint(arrays, app_states, {
-            "names": self.names,
+        # checkpoint_async: every container below is a FRESH object (dict
+        # comps / copies) captured under the manager lock — the writer
+        # thread serializes them while the tick keeps running (a loaded
+        # snapshot costs ~0.5s of json+npz+fsync; paying it in the tick
+        # was the measured latency spike that failed the capacity gate)
+        self.logger.checkpoint_async(arrays, app_states, {
+            "names": dict(self.names),
             "pending_rows": sorted(self.pending_rows),
             "needs_state": sorted(self._needs_state),
             "response_cache": {
@@ -2538,7 +2553,7 @@ class PaxosManager:
             },
             "old_epochs": [[n, e, r] for (n, e), r in self.old_epochs.items()],
             "next_counter": self._next_counter,
-            "arena": self.arena,
+            "arena": dict(self.arena),
             "vid_meta": {k: list(v) for k, v in self.vid_meta.items()},
             "app_exec_slot": self.app_exec_slot.tolist(),
             "pending_exec": {

@@ -19,6 +19,7 @@ from __future__ import annotations
 import enum
 import os
 import struct
+import threading
 import zlib
 from typing import Iterator, List, Optional, Tuple
 
@@ -76,6 +77,10 @@ class Journal:
         self.dir = directory
         self.max_file_size = max_file_size
         self.sync = sync
+        # append/position/gc are serialized: the async checkpoint
+        # writer appends its marker and GCs covered files from a
+        # background thread while the tick thread keeps appending
+        self._lock = threading.RLock()
         os.makedirs(directory, exist_ok=True)
         existing = self.file_indices()
         self._cur_idx = existing[-1] if existing else 0
@@ -122,6 +127,11 @@ class Journal:
         Uses the native appender (header + CRC + write [+fsync] as one C
         call, ``native/gp_journal.cc``) when available; the pure-Python
         path writes the identical bytes."""
+        with self._lock:
+            return self._append_locked(btype, payload, n_rows)
+
+    def _append_locked(self, btype: BlockType, payload: bytes,
+                       n_rows: int = 0) -> Tuple[int, int]:
         lib = self._native
         if lib is not None:
             wrote = lib.gpj_append(
@@ -183,13 +193,19 @@ class Journal:
         fsync (``BatchedLogger`` analog, ``AbstractPaxosLogger.java:656``
         — the durability cost of a tick is one syscall, not one per
         block type).  Pure-Python fallback appends sequentially."""
+        with self._lock:
+            return self._append_many_locked(blocks)
+
+    def _append_many_locked(
+        self, blocks: List[Tuple[BlockType, bytes, int]]
+    ) -> Tuple[int, int]:
         import ctypes
 
         lib = self._native
         if lib is None or not blocks:
             out = self.position
             for btype, payload, n_rows in blocks:
-                out = self.append(btype, payload, n_rows)
+                out = self._append_locked(btype, payload, n_rows)
             return out
         pos = self.position
         for start in range(0, len(blocks), 64):  # native batch cap
@@ -234,7 +250,12 @@ class Journal:
 
     @property
     def position(self) -> Tuple[int, int]:
-        return (self._cur_idx, self._pos)
+        # locked: a concurrent rotation (background checkpoint writer's
+        # marker append) updates _cur_idx and _pos non-atomically — a
+        # torn pair persisted as a snapshot's journal_pos would skip
+        # every post-checkpoint block on recovery
+        with self._lock:
+            return (self._cur_idx, self._pos)
 
     # ---- read ----------------------------------------------------------
     def file_indices(self) -> List[int]:
@@ -279,6 +300,10 @@ class Journal:
     def gc_below(self, file_idx: int) -> int:
         """Delete whole files strictly below file_idx (all their blocks are
         covered by a checkpoint).  Returns #files removed."""
+        with self._lock:
+            return self._gc_below_locked(file_idx)
+
+    def _gc_below_locked(self, file_idx: int) -> int:
         removed = 0
         for idx in self.file_indices():
             if idx >= file_idx or idx == self._cur_idx:
@@ -288,4 +313,5 @@ class Journal:
         return removed
 
     def close(self) -> None:
-        self._fh.close()
+        with self._lock:
+            self._fh.close()

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import contextlib
 import json
+import threading
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
@@ -85,6 +86,10 @@ class PaxosLogger:
 
         self.gc_every = max(1, Config.get_int(PC.JOURNAL_GC_FREQUENCY))
         self._ckpts_since_gc = 0
+        # async checkpoint writer (newest pending snapshot wins)
+        self._ck_lock = threading.Lock()
+        self._ck_pending = None
+        self._ck_thread: Optional[threading.Thread] = None
 
     @contextlib.contextmanager
     def batch(self):
@@ -189,6 +194,38 @@ class PaxosLogger:
         app_states: Dict[str, Optional[str]],
         extra_meta: Optional[Dict[str, Any]] = None,
     ) -> None:
+        pos, meta = self._checkpoint_prepare(app_states, extra_meta)
+        self._checkpoint_write(engine_arrays, meta, pos)
+
+    def checkpoint_async(
+        self,
+        engine_arrays: Dict[str, np.ndarray],
+        app_states: Dict[str, Optional[str]],
+        extra_meta: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        """Journal-side work NOW (on the caller's thread, under its
+        locks); the slow file serialization on a background writer.
+
+        Serializing a loaded node's snapshot — a 64k-entry dedup cache,
+        the live payload arena, npz + two fsyncs + renames — costs
+        ~0.5s, and paying it inside the tick stalls the whole node (the
+        measured latency spikes that failed the capacity gate).  The
+        writer keeps only the NEWEST pending snapshot (an older one is
+        subsumed); a crash before the write lands just means recovery
+        rolls forward from the previous snapshot through the journal,
+        exactly as if the crash had hit moments before the checkpoint.
+        The caller must pass SNAPSHOTTED containers (no live dicts)."""
+        pos, meta = self._checkpoint_prepare(app_states, extra_meta)
+        with self._ck_lock:
+            self._ck_pending = (engine_arrays, meta, pos)
+            if self._ck_thread is None or not self._ck_thread.is_alive():
+                self._ck_thread = threading.Thread(
+                    target=self._ck_drain, daemon=True,
+                    name="gp-checkpoint-writer",
+                )
+                self._ck_thread.start()
+
+    def _checkpoint_prepare(self, app_states, extra_meta):
         if self._batch:
             # the snapshot position must cover every buffered block
             blocks, self._batch = self._batch, []
@@ -197,6 +234,9 @@ class PaxosLogger:
         meta = dict(extra_meta or {})
         meta["journal_pos"] = list(pos)
         meta["app_states"] = app_states
+        return pos, meta
+
+    def _checkpoint_write(self, engine_arrays, meta, pos) -> None:
         save_checkpoint(self.dir, engine_arrays, meta)
         self.journal.append(
             BlockType.CHECKPOINT,
@@ -206,6 +246,28 @@ class PaxosLogger:
         if self._ckpts_since_gc >= self.gc_every:
             self._ckpts_since_gc = 0
             self.journal.gc_below(pos[0])
+
+    def _ck_drain(self) -> None:
+        while True:
+            with self._ck_lock:
+                item, self._ck_pending = self._ck_pending, None
+                if item is None:
+                    self._ck_thread = None
+                    return
+            try:
+                self._checkpoint_write(*item)
+            except Exception:
+                import traceback
+
+                traceback.print_exc()  # next cadence point retries
+
+    def drain_checkpoints(self, timeout: float = 30.0) -> None:
+        """Block until any pending async snapshot is on disk (close/final
+        checkpoint path)."""
+        with self._ck_lock:
+            t = self._ck_thread
+        if t is not None:
+            t.join(timeout)
 
     # ---- recovery ------------------------------------------------------
     def recover(
@@ -369,4 +431,5 @@ class PaxosLogger:
             arrays["bal"][g] = NULL
 
     def close(self) -> None:
+        self.drain_checkpoints()
         self.journal.close()

@@ -97,9 +97,12 @@ def main() -> int:
                               n_replicas=3)  # match the child default
         log_root = None
         if args.durable:
+            import atexit
+            import shutil
             import tempfile
 
             log_root = tempfile.mkdtemp(prefix="gp_probe_journal_")
+            atexit.register(shutil.rmtree, log_root, True)
         nodes = [
             ReconfigurableNode(
                 n, NoopPaxosApp, ar_cfg=ar_cfg, rc_cfg=rc_cfg,
