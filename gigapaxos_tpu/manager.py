@@ -1252,6 +1252,24 @@ class PaxosManager:
                 return False
             return bool(int(self._np("stopped")[row]))
 
+    def app_caught_up(self, name: str) -> bool:
+        """Host app cursor == device frontier for the name's current row:
+        the app state string reflects EVERY decision the device has
+        executed.  The device can run ahead (host execution is
+        payload-gated), so any caller about to serve ``app.checkpoint``
+        as a consistent snapshot must check this — a device-level
+        ``stopped`` flag alone does NOT mean the app has applied the
+        epoch's tail (chaos-sweep find: a truncated 'final state' served
+        from a stopped-on-device/lagging-on-host member diverged the
+        next epoch's joiners)."""
+        with self._state_lock:
+            row = self.names.get(name)
+            if row is None:
+                return False
+            return int(self.app_exec_slot[row]) == int(
+                self._np("exec_slot")[row]
+            )
+
     # ------------------------------------------------------------------
     # propose (PaxosManager.propose/proposeStop, :1195-1390)
     # ------------------------------------------------------------------

@@ -428,13 +428,21 @@ class ActiveReplica:
         if key not in self.final_states:
             # Restart fallback: the in-memory capture was lost, but if this
             # node still hosts (name, epoch) as its CURRENT mapping and the
-            # stop executed (app state == final state), serve a fresh
-            # checkpoint of it.  (Old-epoch rows on overlap members can't
-            # serve — their app state moved on — but the requester
-            # round-robins over all prev actives.)
+            # stop fully applied, serve a fresh checkpoint of it.
+            # (Old-epoch rows on overlap members can't serve — their app
+            # state moved on — but the requester round-robins over all
+            # prev actives.)  `is_stopped` alone is NOT enough: it is the
+            # DEVICE flag, and the host app cursor can lag behind missing
+            # payloads — app.checkpoint would then be a truncated
+            # mid-epoch state served as "final", with a dedup set missing
+            # the tail executions, and the next epoch's joiners would
+            # adopt DIFFERENT histories (the chaos sweep's exactly-once
+            # divergence: one joiner with n_executed+1 vs its peer at
+            # equal frontiers).  Require the app caught up to the device.
             if (
                 self.coordinator.current_epoch(name) != epoch
                 or not self.coordinator.is_stopped(name)
+                or not self.coordinator.app_caught_up(name)
             ):
                 return
             # safe here: this node hasn't moved past `epoch`, so its live

@@ -114,6 +114,11 @@ class AbstractReplicaCoordinator:
     def is_stopped(self, name: str) -> bool:
         raise NotImplementedError
 
+    def app_caught_up(self, name: str) -> bool:
+        """App cursor == device frontier (``app.checkpoint`` is a
+        consistent snapshot of everything executed)."""
+        raise NotImplementedError
+
     def hosts_epoch(self, name: str, epoch: int) -> bool:
         """True if this node still holds (name, epoch) — current or demoted."""
         raise NotImplementedError
@@ -240,6 +245,9 @@ class PaxosReplicaCoordinator(AbstractReplicaCoordinator):
 
     def is_stopped(self, name: str) -> bool:
         return self.manager.is_stopped(name)
+
+    def app_caught_up(self, name: str) -> bool:
+        return self.manager.app_caught_up(name)
 
     def hosts_epoch(self, name: str, epoch: int) -> bool:
         return self.manager.epoch_row(name, epoch) is not None

@@ -257,7 +257,7 @@ def run_soak(
                     if m.names.get(nm) is not None:
                         raise SoakDivergence(
                             "name lingers post-delete",
-                            {"name": nm, "member": m.rid},
+                            {"name": nm, "member": m.my_id},
                         )
                 continue
             if rec.state is RCState.PAUSED:

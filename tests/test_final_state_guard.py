@@ -1,0 +1,104 @@
+"""The epoch-final-state restart fallback must not serve a TRUNCATED
+state (chaos-sweep find, the r4 open exactly-once divergence):
+
+``_handle_request_final_state``'s fallback re-checkpoints a stopped
+group when the in-memory stop-time capture was lost (restart).  The
+``is_stopped`` gate is the DEVICE flag — the host app cursor can lag
+behind a missing payload, so ``app.checkpoint`` there is a mid-epoch
+state whose dedup set is missing the tail executions.  A next-epoch
+joiner adopting it diverges from a joiner that fetched the TRUE final
+state (observed: app_n_executed 3 vs 2 at equal frontiers, one dedup
+entry missing).  The fallback now also requires the app cursor to have
+reached the device frontier (ref semantics: the final state is what the
+epoch EXECUTED — ``ActiveReplica.java:1051``,
+``PaxosManager.java:318-346``).
+"""
+
+import numpy as np
+
+from gigapaxos_tpu.models.apps import HashChainApp
+from gigapaxos_tpu.ops.engine import EngineConfig
+from gigapaxos_tpu.reconfiguration.active_replica import ActiveReplica
+from gigapaxos_tpu.reconfiguration.coordinator import PaxosReplicaCoordinator
+from gigapaxos_tpu.testing.cluster import ManagerCluster
+
+
+def test_fallback_refuses_truncated_final_state():
+    cfg = EngineConfig(n_groups=4, window=8, req_lanes=4, n_replicas=3)
+    c = ManagerCluster(cfg, HashChainApp)
+    try:
+        c.create("svc", members=[0, 1, 2])
+        sent = []
+        ars = [
+            ActiveReplica(
+                r,
+                PaxosReplicaCoordinator(c.managers[r].app, c.managers[r]),
+                (lambda dst, kind, body: sent.append((dst, kind, body))),
+            )
+            for r in range(3)
+        ]
+        # lag a NON-coordinator member: the value reaches the
+        # coordinator via the entry (or a forward), but this member only
+        # ever sees the payload through gossip/pulls — which we drop.
+        # Its DEVICE executes the decision (frontier advances) while the
+        # host app parks on the missing payload.
+        row0 = c.managers[0].names["svc"]
+        coord = c.managers[0].coordinator_of_row(row0)
+        lag = (coord + 1) % 3
+        m1 = c.managers[lag]
+        ar1 = ars[lag]
+        real_on_host = m1.on_host_message
+
+        def drop_payloads(kind, body):
+            if kind in ("payloads", "state_reply"):
+                return  # the payload (and any state heal) never arrives
+            real_on_host(kind, body)
+
+        m1.on_host_message = drop_payloads
+        c.submit("svc", "tail-request", entry=coord)
+        c.run(10)
+        # the epoch-final stop decides and device-executes everywhere
+        c.submit("svc", "", entry=coord, stop=True,
+                 callback=None)
+        c.run(10)
+        row = m1.names["svc"]
+        assert int(np.asarray(m1.state.stopped)[row]) == 1
+        assert m1.is_stopped("svc")
+        # member 1's app never applied the tail request (nor the stop)
+        assert not m1.app_caught_up("svc")
+        assert m1.app.n_executed.get("svc") is None
+
+        # a joiner asks member 1 for the epoch-final state: the fallback
+        # must stay SILENT (serving app.checkpoint here would hand out a
+        # truncated history + truncated dedup set)
+        ar1._handle_request_final_state(
+            {"name": "svc", "epoch": 0, "from": 2}
+        )
+        assert not [m for m in sent if m[1] == "epoch_final_state"], sent
+
+        # member 0 executed everything: its fallback serves, and the
+        # served state carries the full history + the dedup entries
+        m0 = c.managers[coord]
+        assert m0.app_caught_up("svc")
+        ars[coord]._handle_request_final_state(
+            {"name": "svc", "epoch": 0, "from": 2}
+        )
+        served = [m for m in sent if m[1] == "epoch_final_state"]
+        assert served, "caught-up member must serve"
+        body = served[0][2]
+        assert body["state"] == m0.app.checkpoint("svc")
+        assert body["dedup"], "dedup snapshot must ride along"
+
+        # once the payload finally lands, member 1 catches up and serves
+        # the SAME state
+        m1.on_host_message = real_on_host
+        c.run(30)
+        if m1.app_caught_up("svc"):
+            sent.clear()
+            ar1._handle_request_final_state(
+                {"name": "svc", "epoch": 0, "from": 2}
+            )
+            served2 = [m for m in sent if m[1] == "epoch_final_state"]
+            assert served2 and served2[0][2]["state"] == body["state"]
+    finally:
+        c.close()
