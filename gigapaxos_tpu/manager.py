@@ -1017,7 +1017,21 @@ class PaxosManager:
                 )
             if rec is None:
                 # no local state at all: join with the birth state (if
-                # the caller knows it) and heal via state transfer
+                # the caller knows it) and heal via state transfer.
+                # A REJOIN wipes the app back to the birth state, so
+                # this member's OWN response-cache entries for the name
+                # describe executions the adopted state does NOT contain
+                # — kept, they would suppress re-executing those
+                # decisions into the blank state and freeze the RSM
+                # (audit-heal find: a rejoined member at exec==cursor
+                # with an empty app state, forever).  Epoch>0 joins
+                # adopt a donor's state+dedup wholesale via _needs_state;
+                # epoch-0 rejoins rebuild by re-executing history.
+                for rid in [
+                    r for r, (_t, _resp, nm) in self.response_cache.items()
+                    if nm == name
+                ]:
+                    del self.response_cache[rid]
                 ok = self._create_locked(
                     name, members, initial_state, epoch, int(row), pending
                 )

@@ -28,7 +28,12 @@ class RC(FlagEnum):
 
     # ---- task re-drive machinery (TPU-build specific) ------------------
     REDRIVE_EVERY = 32          # reconfigurator ticks between record scans
-    MAX_REDROPS = 8             # retry budget for post-delete straggler drops
+    MAX_REDROPS = 8             # fast-retry budget for post-delete straggler drops
+    # slow-cadence re-verification of settled state: READY records get
+    # their (idempotent) commit round re-run, and budget-exhausted
+    # post-delete drops retried, once per this period — heals members
+    # that lost their row or missed a drop AFTER the fast rounds ended
+    READY_AUDIT_PERIOD_S = 120.0
 
     # ---- delete (ref: ReconfigurationConfig MAX_FINAL_STATE_AGE 3600s;
     # here the explicit drop rounds + redrops subsume the age-out, this

@@ -21,6 +21,7 @@ because capacity G far exceeds live names (PINSTANCES_CAPACITY 2M analog).
 from __future__ import annotations
 
 import json
+import time
 import zlib
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -279,7 +280,9 @@ class EpochCommitTask(ThresholdProtocolTask):
     def on_threshold(self):
         # keyed by ROW as well: a reactivation keeps the epoch but moves
         # the row, and its commit round must be re-drivable independently
-        self.rcf._commit_done.add((self.name, self.epoch, self.row))
+        self.rcf._commit_done[(self.name, self.epoch, self.row)] = (
+            time.monotonic()
+        )
         return ()
 
 
@@ -471,11 +474,22 @@ class Reconfigurator:
         # epochs whose drop expired with unreached stragglers: re-dropped
         # periodically so a long-partitioned active doesn't leak the row
         # forever (MAX_FINAL_STATE_AGE re-drop analog)
-        self._unfinished_drops: Dict[Tuple[str, int], List[int]] = {}
+        # (name, epoch) -> (stragglers, attempts, last attempt time)
+        self._unfinished_drops: Dict[Tuple[str, int], Tuple] = {}
         # epochs whose commit round every active confirmed; READY records
         # not in here get the round re-driven (in-memory: a restarted RC
         # re-confirms each READY record once — idempotent at the ARs)
-        self._commit_done: set = set()
+        # (name, epoch, row) -> completion time of the last commit
+        # round.  A TIMESTAMP, not a set: a member can lose its row
+        # AFTER the round completed (failed re-home, aborted pause)
+        # with nothing left to probe — the READY audit re-runs the
+        # idempotent commit round at a slow cadence so such members
+        # are eventually re-healed (chaos-sweep find: a READY record
+        # with one member hosting nothing, forever)
+        self._commit_done: Dict[Tuple[str, int, int], float] = {}
+        self.ready_audit_period_s = Config.get_float(
+            RC.READY_AUDIT_PERIOD_S
+        )
         # last row-probe attempt per name: an expired start task's re-drive
         # resumes probing here instead of restarting at attempt 0
         self._last_attempt: Dict[str, int] = {}
@@ -599,22 +613,28 @@ class Reconfigurator:
     ) -> None:
         prev = self._unfinished_drops.get((name, epoch))
         self._unfinished_drops[(name, epoch)] = (
-            list(stragglers), prev[1] if prev else 0
+            list(stragglers), prev[1] if prev else 0, 0.0
         )
 
     def _redrive_unfinished_drops(self) -> None:
-        for (name, epoch), (nodes, att) in list(self._unfinished_drops.items()):
+        for (name, epoch), (nodes, att, last_t) in list(
+            self._unfinished_drops.items()
+        ):
             key = f"redrop:{name}:{epoch}"
             if self.tasks.is_running(key):
                 continue
             if att >= self.MAX_REDROPS:
-                # age out (MAX_FINAL_STATE_AGE analog): a permanently
-                # removed active must not accumulate retransmit work
-                # forever — its rows are reclaimed wholesale if/when it
-                # ever rejoins
-                self._unfinished_drops.pop((name, epoch), None)
-                continue
-            self._unfinished_drops[(name, epoch)] = (list(nodes), att + 1)
+                # budget exhausted: fall back to the slow audit cadence
+                # instead of giving up FOREVER (chaos-sweep find: names
+                # lingering post-delete once the redrop budget burned out
+                # during a lossy phase) — one attempt per audit period is
+                # bounded traffic, and a straggler that heals mid-window
+                # acks the next attempt
+                if time.monotonic() - last_t < self.ready_audit_period_s:
+                    continue
+            self._unfinished_drops[(name, epoch)] = (
+                list(nodes), att + 1, time.monotonic()
+            )
             self.tasks.spawn_if_not_running(
                 key,
                 lambda k=key, n=name, e=epoch, nd=list(nodes): DropEpochTask(
@@ -1317,7 +1337,12 @@ class Reconfigurator:
                             ),
                         })
                         continue
-                if (name, rec.epoch, rec.row) not in self._commit_done:
+                done_t = self._commit_done.get(
+                    (name, rec.epoch, rec.row)
+                )
+                if done_t is None or (
+                    time.monotonic() - done_t > self.ready_audit_period_s
+                ):
                     ckey = f"commit:{name}:{rec.epoch}:{rec.row}"
                     self.tasks.spawn_if_not_running(
                         ckey,
@@ -1417,8 +1442,11 @@ class Reconfigurator:
                 )
         # confirmed-commit entries for purged records / superseded
         # epochs / moved rows
-        self._commit_done &= {
+        live = {
             (n, r.epoch, r.row) for n, r in self.rc_app.records.items()
+        }
+        self._commit_done = {
+            k: t for k, t in self._commit_done.items() if k in live
         }
 
     # ------------------------------------------------------------------

@@ -107,3 +107,61 @@ def test_resume_heal_shapes():
     for nm, row in (("x", 2), ("y", 4), ("z", 5)):
         commit(ar, nm, 0, row)
         assert last_ack(sent)["ok"], nm
+
+
+def test_ready_audit_heals_post_commit_row_loss():
+    """Chaos-sweep find: a member can lose its row AFTER the epoch's
+    commit round completed (failed re-home / aborted pause) — it holds
+    no pause record and no pending row, so no probe fires, and the old
+    one-shot commit round never re-runs: the READY record keeps a
+    member hosting NOTHING forever.  The slow READY audit re-runs the
+    idempotent commit round; its missing-NACK drives the committed
+    resume that re-joins the member."""
+    import time as _t
+
+    from gigapaxos_tpu.models.apps import HashChainApp
+    from gigapaxos_tpu.ops.engine import EngineConfig
+    from gigapaxos_tpu.testing.rc_cluster import ReconfigurableCluster
+
+    ar_cfg = EngineConfig(n_groups=16, window=8, req_lanes=4, n_replicas=3)
+    rc_cfg = EngineConfig(n_groups=8, window=8, req_lanes=4, n_replicas=3)
+    c = ReconfigurableCluster(ar_cfg, rc_cfg, HashChainApp)
+    try:
+        for rc in c.reconfigurators:
+            rc.REDRIVE_EVERY = 4
+            rc.ready_audit_period_s = 0.3  # fast audit for the test
+        for ar in c.active_replicas:
+            ar.pause_option = False
+        c.client_request("create_service", {"name": "pl", "actives": [0, 1, 2]})
+        ack = c.wait_for("create_ack", max_steps=200)
+        assert ack and ack["ok"], ack
+        done = {}
+        c.ars.managers[0].propose(
+            "pl", "w", callback=lambda rid, r: done.setdefault(rid, r)
+        )
+        for _ in range(80):
+            if done:
+                break
+            c.step()
+        assert done
+
+        # post-commit row loss on member 2: no pause record, no pending
+        # row — only the audit can see it
+        m2 = c.ars.managers[2]
+        assert m2.kill("pl")
+        assert m2.names.get("pl") is None
+
+        deadline = _t.time() + 60
+        while _t.time() < deadline and m2.names.get("pl") is None:
+            c.step()
+        assert m2.names.get("pl") is not None, "audit never re-healed"
+        # and the healed member converges to the group state
+        deadline = _t.time() + 60
+        while _t.time() < deadline:
+            states = {m.app.state.get("pl") for m in c.ars.managers}
+            if len(states) == 1:
+                break
+            c.step()
+        assert len(states) == 1, states
+    finally:
+        c.close()
