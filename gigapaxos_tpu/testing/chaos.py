@@ -61,6 +61,16 @@ def _name_diag(c: ReconfigurableCluster, nm: str, actives: List[int]) -> Dict:
                 version=int(m._np("version")[row]),
             )
         ent["dedup"] = sorted(m.dedup_for_name(nm))
+        # provenance for handoff forensics: which epoch-final snapshots
+        # this member holds for the name, and each snapshot's dedup size
+        ar = c.active_replicas[a]
+        ent["final_states"] = {
+            f"{n}@{e}": len(s.get("dedup") or {})
+            for (n, e), s in ar.final_states.items() if n == nm
+        }
+        ent["old_epochs"] = sorted(
+            e for (n, e) in m.old_epochs if n == nm
+        )
         out[a] = ent
     return out
 
