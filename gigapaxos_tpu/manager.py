@@ -2245,14 +2245,20 @@ class PaxosManager:
         self.total_executed += 1
         self._slots_since_ckpt += 1
         self.inflight.pop(request_id, None)
+        response = getattr(req, "response_value", None)
+        # cache BEFORE the stop hook: the hook snapshots (app state,
+        # dedup set) as the epoch-final handoff pair, and the app state
+        # it captures INCLUDES this stop execution — a snapshot whose
+        # dedup set lacks the stop's own entry is an inconsistent pair
+        # (chaos-sweep forensics: every breach diff was missing exactly
+        # one epoch-final stop id)
+        self._cache_response(request_id, response, name or "")
         if (vid & STOP_BIT) and self.on_stop_executed is not None and name:
             epoch = int(self._np("version")[g])
             try:
                 self.on_stop_executed(name, g, epoch)
             except Exception:
                 pass  # reconfiguration-layer hook must not wedge execution
-        response = getattr(req, "response_value", None)
-        self._cache_response(request_id, response, name or "")
         if entry == self.my_id:
             cb = self.outstanding.pop(request_id)
             if cb is not None:
