@@ -162,6 +162,10 @@ def run_soak(
         c = ReconfigurableCluster(ar_cfg, rc_cfg, HashChainApp)
         for rc in c.reconfigurators:
             rc.REDRIVE_EVERY = 4
+            # compress the slow READY-audit cadence to the soak's
+            # timescale (like the 0.05s task retransmits): audit-healed
+            # shapes must fit inside the settle budget
+            rc.ready_audit_period_s = 2.0
         names = [f"n{i}" for i in range(n_names)]
 
         def step():
@@ -263,6 +267,13 @@ def run_soak(
 
         for nm, rec in recs.items():
             if rec is None or rec.deleted:
+                # poll: a straggler that missed the drop (it could not
+                # ack while its stop was un-executed) heals through the
+                # audit-cadence redrop — give that machinery a window
+                for _ in range(600):
+                    if all(m.names.get(nm) is None for m in c.ars.managers):
+                        break
+                    step()
                 for m in c.ars.managers:
                     if m.names.get(nm) is not None:
                         raise SoakDivergence(
