@@ -1169,6 +1169,24 @@ class PaxosManager:
                 return
             self._kill_locked(name)
 
+    def stopped_row_keys(self) -> List[Tuple[str, int]]:
+        """(name, epoch) of CURRENT mappings whose epoch-final stop has
+        executed.  A stopped current row is always awaiting an epoch
+        transition (the delete's drop round, or an upgrade) — normally
+        transient, but a drop can RACE residency: a member that acked
+        the drop while paused (not hosting), then resumed and executed
+        the stop, holds a live stopped row with no record and no
+        bookkeeping left to clean it (chaos-sweep find: names lingering
+        post-delete).  The epoch probe asks the RC about these."""
+        out = []
+        with self._state_lock:
+            versions = self._np("version")
+            stopped = self._np("stopped")
+            for name, row in self.names.items():
+                if int(stopped[row]):
+                    out.append((name, int(versions[row])))
+        return out
+
     def pause_record_keys(self) -> List[Tuple[str, int]]:
         """(name, epoch) of every locally held pause record (the AR layer
         probes the RC about them: a record the RC no longer knows is

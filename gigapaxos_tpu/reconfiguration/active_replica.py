@@ -152,15 +152,21 @@ class ActiveReplica:
         elif kind == "epoch_gone":
             # RC's answer to an epoch_probe: the probed (name, epoch) is
             # obsolete — GC whichever stranded form this member holds (a
-            # pause record, or a row stuck behind the admission gate)
+            # pause record, a row stuck behind the admission gate, or a
+            # live STOPPED row whose drop round this member missed)
             if body.get("row") is not None:
                 self.coordinator.drop_pending_row(
                     body["name"], int(body["epoch"]), int(body["row"])
                 )
             else:
-                self.coordinator.drop_pause_record(
-                    body["name"], int(body["epoch"])
-                )
+                name, epoch = body["name"], int(body["epoch"])
+                self.coordinator.drop_pause_record(name, epoch)
+                if self.coordinator.current_epoch(name) == epoch and \
+                        self.coordinator.is_stopped(name):
+                    # safe: only a STOPPED row dies (never a live group),
+                    # and only after the RC confirmed the epoch is gone
+                    self.coordinator.delete_replica_group(name, epoch)
+                    self.final_states.pop((name, epoch), None)
 
     def tick(self, now: Optional[float] = None) -> None:
         self.tasks.tick(now)
@@ -219,6 +225,11 @@ class ActiveReplica:
         ] + [
             (n, int(e), int(r))
             for n, e, r in self.coordinator.pending_row_keys()
+        ] + [
+            # live STOPPED current rows: awaiting a transition a race can
+            # lose (a drop acked while this member was paused)
+            (n, int(e), None)
+            for n, e in self.coordinator.stopped_row_keys()
         ]
         live = set(probes)
         for k in [k for k in self._probe_backoff if k not in live]:

@@ -87,6 +87,12 @@ class AbstractReplicaCoordinator:
         """(name, epoch, row) of rows stuck pre-COMPLETE (probe targets)."""
         return []
 
+    def stopped_row_keys(self):
+        """(name, epoch) of current rows whose epoch-final stop has
+        executed (probe targets: they await a transition that a race can
+        lose)."""
+        return []
+
     def drop_pending_row(self, name: str, epoch: int, row: int) -> None:
         """Free a pending row whose epoch the RC says is gone."""
 
@@ -224,6 +230,9 @@ class PaxosReplicaCoordinator(AbstractReplicaCoordinator):
 
     def pending_row_keys(self):
         return self.manager.pending_row_keys()
+
+    def stopped_row_keys(self):
+        return self.manager.stopped_row_keys()
 
     def drop_pending_row(self, name: str, epoch: int, row: int) -> None:
         self.manager.drop_pending_row(name, epoch, row)

@@ -293,17 +293,20 @@ def run_soak(
             # Re-read each poll: the deactivation sweep can pause a name
             # mid-poll; commit-round re-drives heal missed starts.
             rows: set = set()
-            for _ in range(600):
+            # deadline-bound like the settle loop: the audit-cadence
+            # heals (READY audit re-running the commit round) are
+            # wall-timer-gated, so an iteration cap alone can expire
+            # before the timers their heals need have fired
+            align_deadline = time.time() + 90
+            while True:
                 rec = c.reconfigurators[0].rc_app.get_record(nm)
                 if rec is None or rec.deleted or \
                         rec.state is not RCState.READY:
                     break
                 rows = {c.ars.managers[a].names.get(nm) for a in rec.actives}
-                if rows == {rec.row}:
+                if rows == {rec.row} or time.time() > align_deadline:
                     break
                 step()
-            else:
-                rows = {c.ars.managers[a].names.get(nm) for a in rec.actives}
             if rec is None or rec.deleted or rec.state is not RCState.READY:
                 continue
             if rows != {rec.row}:
