@@ -2518,7 +2518,19 @@ class PaxosManager:
                 np.array([e["n_execd"] for e in jumps]),
                 np.array([e["stopped"] for e in jumps]),
             )
-        self.install_dedup(response_cache)
+        # install the donor's dedup entries ONLY for names whose state
+        # was actually ADOPTED here: an entry is sound exactly when it is
+        # paired with a state that contains its execution.  Installing a
+        # served-but-not-adopted name's entries would DEDUP-SKIP this
+        # member's own parked executions of those requests once their
+        # payloads arrive — a truncated history with a full dedup set
+        # (the chaos sweeps' remaining breach shape: identical dedup
+        # sets, app_n_executed 5 vs 3 at equal frontiers).
+        adopted = {e["name"] for e in jumps} | {e["name"] for e in app_only}
+        self.install_dedup({
+            rid: ent for rid, ent in (response_cache or {}).items()
+            if str(ent[2]) in adopted
+        })
         for ent in jumps:
             g = int(ent["row"])
             self.app.restore(ent["name"], ent["app_state"])
