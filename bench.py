@@ -197,7 +197,13 @@ def main() -> None:
 
     CHUNK = 10
 
-    @jax.jit
+    from functools import partial
+
+    # donate the states: the previous chunk's buffers are dead once the
+    # next chunk starts, so XLA reuses them in place — without this the
+    # bench holds TWO full state copies across the dispatch boundary,
+    # which is half the G=2M headroom on a 16GB chip
+    @partial(jax.jit, donate_argnums=(0,))
     def run_chunk(states, base):
         def body(s, i):
             if failover:

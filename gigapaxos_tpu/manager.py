@@ -67,7 +67,15 @@ from .storage.logger import PaxosLogger
 from .utils.profiler import DelayProfiler
 
 _step_jit = jax.jit(step, static_argnames=("cfg",))
-_step_host_jit = jax.jit(step_host, static_argnames=("cfg",))
+# donate the state: the manager owns it exclusively (every external view
+# is an identity check or a host-side numpy copy), so the old buffers may
+# be reused in place by the new state — on-device this halves state HBM;
+# backends without donation support ignore it.  The per-leaf _step_jit
+# path is NOT donated: its returned blob leaves alias the live state (the
+# test-cluster harness caches those blobs across ticks).
+_step_host_jit = jax.jit(
+    step_host, static_argnames=("cfg",), donate_argnums=(0,)
+)
 _pack_blob_jit = jax.jit(pack_blob)
 
 
@@ -137,6 +145,31 @@ class SlimRequest(RequestPacket):
         self.response_value = None
         self.batched = []
         self.entry_time = 0.0
+
+
+def execute_uncoordinated(app, names, name: str, value: str, request_id,
+                          callback) -> Optional[bool]:
+    """Uncoordinated local execution (linearizable-writes / local-reads
+    apps, ref ``LinWritesLocReadsApp.java:26-44``): when the app declares
+    a request uncoordinated via ``is_coordinated``, answer it from THIS
+    replica's state without entering consensus — no vid, no inflight
+    slot, no dedup entry (a re-sent read just re-reads).  The ONE routing
+    block shared by the coordinator and the server ingress paths.
+
+    Returns ``True`` if executed locally, ``False`` if the request IS
+    uncoordinated but ``name`` isn't hosted here, ``None`` if the app
+    doesn't route or the request is coordinated (caller proposes
+    normally)."""
+    is_coord = getattr(app, "is_coordinated", None)
+    if is_coord is None or is_coord(value):
+        return None
+    if names.get(name) is None:
+        return False
+    req = SlimRequest(name, int(request_id or 0), value)
+    app.execute(req, do_not_reply_to_client=False)
+    if callback is not None:
+        callback(request_id, getattr(req, "response_value", None))
+    return True
 
 
 class Outstanding:
@@ -395,11 +428,18 @@ class PaxosManager:
         self._recover()
 
     def _np(self, leaf: str) -> np.ndarray:
-        """Cached host view of an engine leaf for the CURRENT state object
+        """Cached host copy of an engine leaf for the CURRENT state object
         (one transfer per leaf per state version, not per accessor call).
         Takes the state lock: an unlocked reader racing the tick thread's
         state replacement could otherwise store an OLD state's array under
-        the NEW state's cache and poison every later reader."""
+        the NEW state's cache and poison every later reader.
+
+        The returned array is a PRIVATE copy when np.asarray would be a
+        zero-copy view of the device buffer (`.base` set — the CPU
+        backend): _step_host_jit donates the state, so a view held by a
+        transport thread past its lock region would read buffers a later
+        tick overwrites in place.  Device backends already transfer into
+        a fresh host buffer (`.base` None)."""
         with self._state_lock:
             if self._np_cache_state is not self.state:
                 self._np_cache = {}
@@ -407,6 +447,8 @@ class PaxosManager:
             arr = self._np_cache.get(leaf)
             if arr is None:
                 arr = np.asarray(getattr(self.state, leaf))
+                if arr.base is not None:
+                    arr = arr.copy()
                 self._np_cache[leaf] = arr
             return arr
 
@@ -1507,7 +1549,13 @@ class PaxosManager:
                     results.append((rid, "inflight", None))
                     continue
                 if self._next_counter > VID_COUNTER_MASK:
-                    raise RuntimeError("vid counter space exhausted")
+                    # per-item failure, NOT a raise: a mid-frame exception
+                    # would discard the already-collected cached responses
+                    # in `results` and never fire the callbacks queued in
+                    # `fired` — and an up-front whole-frame reject would
+                    # deny cached/inflight items that mint no vid at all
+                    results.append((rid, "exhausted", None))
+                    continue
                 vid = (self.my_id << VID_NODE_SHIFT) | self._next_counter
                 self._next_counter += 1
                 if rid is None:
@@ -1869,7 +1917,7 @@ class PaxosManager:
         want_coord: Optional[np.ndarray] = None,
     ) -> Tuple[np.ndarray, "EngineState", Dict]:
         """Packed-I/O tick for the deployed socket runtime: `gathered_vec`
-        is the [R, N] stack of packed peer blob vectors (== the `C` wire
+        is the [R, N] stack of packed peer blob vectors (== the `D` wire
         frame bodies); returns (my fresh packed blob vector, the state it
         reflects — for identity-based staleness checks, captured under
         the lock so lifecycle ops can't mispair them — and the host
@@ -2586,7 +2634,12 @@ class PaxosManager:
         DelayProfiler.update_count("t_checkpoint", time.monotonic() - t_ck)
 
     def _checkpoint_now_inner(self) -> None:
-        arrays = {k: np.asarray(v) for k, v in self.state._asdict().items()}
+        # _np returns donation-safe PRIVATE host arrays (never zero-copy
+        # views of the device buffers — see its docstring), so the async
+        # writer can serialize them while later donated ticks overwrite
+        # the device state in place; going through it also shares the
+        # per-state-version cache with the hot accessors
+        arrays = {k: self._np(k) for k in self.state._fields}
         app_states = {
             name: self.app.checkpoint(name) for name in self.names
         }
@@ -2650,7 +2703,7 @@ class PaxosManager:
 
     def blob_vec(self) -> np.ndarray:
         """Packed publish vector for the current state (the wire body of
-        a `C` frame); used by the socket runtime at boot and after
+        a `D` frame); used by the socket runtime at boot and after
         lifecycle ops, before the first packed tick returns one."""
         return self.publish_snapshot()[0]
 

@@ -6,13 +6,16 @@ and hand-rolled byte layouts on one NIO channel,
 
 * ``J`` frames — JSON control messages: host-channel deltas, client
   requests/responses, failure-detection pings, admin ops.
-* ``C`` frames — packed engine blobs: sender id + tick + raw int32 leaf
+* ``D`` frames — packed engine blobs: sender id + tick + raw int32 leaf
   bytes in ``Blob._fields`` order (shapes are static per EngineConfig, so
   no per-leaf headers are needed — the reference's fixed-layout
   ``RequestPacket.toBytes`` idea applied to whole state arrays).  The
   kind byte doubles as the blob SCHEMA version (``B`` was the pre-tag
-  layout): a fixed-layout frame from a different schema must be dropped
-  by kind, never parsed misaligned.
+  layout; ``C`` the pre-compact all-int32 layout; ``D`` is the compact
+  exec-anchored layout, ``ops/engine.py`` module docstring): a
+  fixed-layout frame from a different schema must be dropped by kind,
+  never parsed misaligned — a mixed-version node fails loudly instead
+  of feeding misparsed ballots into consensus.
 """
 
 from __future__ import annotations
@@ -49,7 +52,7 @@ def blob_shapes(cfg: EngineConfig):
 
 
 def encode_blob(sender: int, tick: int, blob: Blob) -> bytes:
-    parts = [_BHDR.pack(b"C", sender, tick)]
+    parts = [_BHDR.pack(b"D", sender, tick)]
     for leaf in blob:
         parts.append(np.asarray(leaf, np.int32).tobytes())
     return b"".join(parts)
@@ -59,7 +62,7 @@ def encode_blob_vec(sender: int, tick: int, vec: np.ndarray) -> bytes:
     """Packed-vector fast path: `vec` is already the frame body (leaf
     C-order ravels in ``Blob._fields`` order — identical bytes to
     :func:`encode_blob`)."""
-    return _BHDR.pack(b"C", sender, tick) + np.ascontiguousarray(
+    return _BHDR.pack(b"D", sender, tick) + np.ascontiguousarray(
         vec, np.int32
     ).tobytes()
 
@@ -70,7 +73,11 @@ def decode_blob_vec(
     """Zero-split decode for the packed tick path: the frame body IS the
     [N] gathered-row vector.  Same size check as :func:`decode_blob`."""
     kind, sender, tick = _BHDR.unpack_from(payload, 0)
-    assert kind == b"C"
+    if kind != b"D":
+        raise ValueError(
+            f"blob frame schema {kind!r} != expected b'D' "
+            "(mixed-version peer; refusing to parse)"
+        )
     n = blob_vec_len(cfg)
     if len(payload) != _BHDR.size + 4 * n:
         raise ValueError(
@@ -82,7 +89,11 @@ def decode_blob_vec(
 
 def decode_blob(payload: bytes, cfg: EngineConfig) -> Tuple[int, int, Blob]:
     kind, sender, tick = _BHDR.unpack_from(payload, 0)
-    assert kind == b"C"
+    if kind != b"D":
+        raise ValueError(
+            f"blob frame schema {kind!r} != expected b'D' "
+            "(mixed-version peer; refusing to parse)"
+        )
     shapes = blob_shapes(cfg)
     expect = _BHDR.size + 4 * sum(int(np.prod(s)) for s in shapes.values())
     if len(payload) != expect:

@@ -48,6 +48,37 @@ Ring convention: window lane ``j`` always holds slot ``s`` with
 windows align lane-for-lane across replicas and the whole step is
 element-wise + [R]-axis reductions — no scatters, no dynamic shapes.
 
+Compact exchange format: the published blob does NOT ship absolute
+``[G, W]`` slot planes or per-lane ballots.  The ring convention makes a
+lane's absolute slot reconstructible from the sender's ``exec_slot``
+anchor plus a small ring-epoch ("wrap") delta, and an accepted lane's
+ballot is reconstructible from the sender's promised ``bal`` minus a
+small delta (acceptance happens AT the promise ballot, so the delta is 0
+in steady state).  All three wrap deltas (5 bits each, biased, 0=NULL)
+and the accepted-ballot delta (16 bits, 0=NULL) bit-pack into ONE int32
+``lane_meta`` plane, and the two coordinator-intent scalars
+(``prep_bal``/``prop_bal`` — mutually exclusive by phase) pack into one
+``coord`` word.  Net: 4 ``[G]`` + 4 ``[G, W]`` int32 leaves instead of
+5 + 7 — 42% fewer exchange bytes at W=32 (528 B/group vs 916), which is
+directly HBM for the gathered rows, ICI bytes for the all_gather, and
+socket bytes for the loopback ``D`` wire frame.
+
+Representability bound: a wrap delta spans ±15 ring epochs around the
+sender's frontier (±480 slots at W=32).  Ring CONTENT is inherently
+within ~1 epoch of the sender's frontier (lanes are overwritten as the
+ring wraps), so in-range lanes lose nothing; the lanes that saturate are
+(a) stale accepted residue far below a sender that caught up by jumping,
+and (b) far-ahead decisions a laggard mirrored from an ahead peer.  Both
+encode as NULL, and both are liveness aids only: (a) is covered for
+safety by the election floor rule (a promiser's own ``exec_slot`` rides
+in the blob and floors new proposals, so a hidden accepted value below it
+can never be contradicted), and any receiver lagging that far heals via
+the host sync/checkpoint-jump protocols, not the rings.  The accepted-
+ballot delta saturates once ``bal - acc_bal`` exceeds 2^16 in ENCODED
+ballot space — ~2^11 ballot-number bumps, since a packed ballot steps by
+2^COORD_BITS (ballot.py) — on a still undecided lane; the same NULL-out
+applies.
+
 TPU lowering note: the step deliberately contains NO gathers — no
 ``argmax``+``take_along_axis`` row selection.  Measured on a v5e chip,
 each such gather inside the fused step cost ~50-100ms at G=1M (vs ~10ms
@@ -58,6 +89,16 @@ ballot proposes one value per slot), so "pick any matching row" ==
 "masked max over matching rows".  Likewise the majority-rank frontier
 uses an O(R^2) rank count instead of a sort, and ``% W`` is a bitmask
 (W is required to be a power of two).
+
+Transient note: the cross-replica reductions (accept-winner select,
+learn, decision-ring merge, carryover) run as a ``lax.fori_loop`` fold
+over the R peer axis with ``[G, W]`` carries, decoding one peer row at a
+time — the step never materializes a ``[R, G, W]`` masked intermediate.
+The execute rotation and admission placement likewise run as static
+unrolls over W/K offsets with ``[G, W]`` temporaries instead of
+``[G, W, W]`` / ``[G, K, W]`` one-hots.  At G=1M/W=32 this cuts peak
+step transients from ~8 GB (R- and W-fanned intermediates) to a small
+multiple of one ``[G, W]`` plane (~128 MB each).
 """
 
 from __future__ import annotations
@@ -66,6 +107,7 @@ from typing import NamedTuple
 
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from .ballot import NULL, ballot_num, encode_ballot
 
@@ -86,12 +128,30 @@ STOP_BIT = 1 << 30
 # whose init can hang (the process never reaches the code that pins cpu)
 _BIG = np.int32(2 ** 30)
 
+# ---- compact lane_meta bit layout (one int32 per lane) --------------------
+# [ 0:16) accepted-ballot delta field: 0 = lane empty/unrepresentable,
+#         else (sender_bal - acc_bal) + 1  (delta <= DELTA_MAX)
+# [16:21) accepted-slot wrap field   \  0 = NULL, else ring-epoch delta
+# [21:26) decided-slot wrap field     } vs the sender's exec_slot anchor,
+# [26:31) proposal-slot wrap field   /  biased by WRAP_BIAS
+# [31]    always 0 (meta stays non-negative)
+WRAP_MAX = 15                 # wrap delta in [-WRAP_MAX, WRAP_MAX]
+WRAP_BIAS = 16                # stored = delta + bias; 0 reserved for NULL
+_WRAP_MASK = 31
+DELTA_MAX = 0xFFFE            # max representable (bal - acc_bal)
+_META_DELTA_MASK = 0xFFFF
+_ACC_SHIFT = 16
+_DEC_SHIFT = 21
+_PROP_SHIFT = 26
+
 
 class EngineConfig(NamedTuple):
     """Static engine shape (all python ints — closed over by jit).
 
     ``window`` must be a power of two: lane residue (slot % W) compiles to
     a bitmask, which matters on TPU where integer modulo is ~10x an AND.
+    ``req_lanes`` must not exceed ``window``: K admission candidates are
+    consecutive slots, whose ring lanes are distinct only while K <= W.
     """
 
     n_groups: int          # G: group capacity (PINSTANCES_CAPACITY analog)
@@ -135,20 +195,39 @@ class EngineState(NamedTuple):
 
 
 class Blob(NamedTuple):
-    """What one replica publishes per step (the all_gather payload)."""
+    """What one replica publishes per step (the all_gather payload) —
+    the COMPACT exchange format (see the module docstring).  All leaves
+    int32; narrow fields bit-pack inside ``lane_meta``/``coord``, so the
+    packed wire vector stays a plain int32 ravel."""
 
     tag: jnp.ndarray         # [G] sender's instance tag (cross-instance guard)
-    bal: jnp.ndarray         # [G]
-    exec_slot: jnp.ndarray   # [G]
-    acc_bal: jnp.ndarray     # [G, W]
-    acc_vid: jnp.ndarray     # [G, W]
-    acc_slot: jnp.ndarray    # [G, W]
-    dec_vid: jnp.ndarray     # [G, W]
-    dec_slot: jnp.ndarray    # [G, W]
-    prep_bal: jnp.ndarray    # [G]  my prepare intent (NULL if not PREPARING)
-    prop_bal: jnp.ndarray    # [G]  my active ballot (NULL if not ACTIVE)
-    prop_vid: jnp.ndarray    # [G, W]
-    prop_slot: jnp.ndarray   # [G, W]
+    bal: jnp.ndarray         # [G] promised ballot (also the acc_bal anchor)
+    exec_slot: jnp.ndarray   # [G] frontier (also the slot-wrap anchor)
+    coord: jnp.ndarray       # [G] packed coordinator intent: NULL when IDLE,
+    #   c_bal when PREPARING, c_bal|INT32_MIN when ACTIVE (the sign bit is
+    #   free: valid ballots are non-negative, ballot.py)
+    acc_vid: jnp.ndarray     # [G, W] accepted value (NULL when lane dropped)
+    dec_vid: jnp.ndarray     # [G, W] decided value (NULL when lane dropped)
+    prop_vid: jnp.ndarray    # [G, W] proposal value (NULL unless ACTIVE)
+    lane_meta: jnp.ndarray   # [G, W] packed wrap deltas + accepted-bal delta
+
+
+class ExpandedBlob(NamedTuple):
+    """A compact blob decoded back to absolute planes (tests/debugging —
+    the step itself decodes peer rows one at a time inside its fold)."""
+
+    tag: jnp.ndarray
+    bal: jnp.ndarray
+    exec_slot: jnp.ndarray
+    acc_bal: jnp.ndarray
+    acc_vid: jnp.ndarray
+    acc_slot: jnp.ndarray
+    dec_vid: jnp.ndarray
+    dec_slot: jnp.ndarray
+    prep_bal: jnp.ndarray
+    prop_bal: jnp.ndarray
+    prop_vid: jnp.ndarray
+    prop_slot: jnp.ndarray
 
 
 class StepOutputs(NamedTuple):
@@ -195,23 +274,100 @@ def init_state(cfg: EngineConfig) -> EngineState:
 
 
 def make_blob(state: EngineState) -> Blob:
-    """Atomic snapshot of what peers need; masked by coordinator phase."""
+    """Atomic COMPACT snapshot of what peers need; masked by coordinator
+    phase, anchored to this replica's ``exec_slot``/``bal``.  A lane whose
+    slot falls outside the ±WRAP_MAX ring-epoch window (or whose accepted
+    ballot trails ``bal`` by more than DELTA_MAX) publishes as NULL — see
+    the module docstring for why that is safe."""
+    W = state.acc_bal.shape[-1]
+    if W & (W - 1):
+        raise ValueError(f"window must be a power of two, got {W}")
+    kbits = W.bit_length() - 1
+    ebase = (state.exec_slot >> kbits)[..., None]
+
+    def wrap_enc(slot):
+        c = (slot >> kbits) - ebase
+        ok = (slot != NULL) & (c >= -WRAP_MAX) & (c <= WRAP_MAX)
+        return ok, jnp.where(ok, c + WRAP_BIAS, 0)
+
+    acc_in, acc_w = wrap_enc(state.acc_slot)
+    delta = state.bal[..., None] - state.acc_bal
+    acc_ok = acc_in & (state.acc_bal != NULL) & (delta >= 0) & (delta <= DELTA_MAX)
+    acc_w = jnp.where(acc_ok, acc_w, 0)
+    acc_d = jnp.where(acc_ok, delta + 1, 0)
+    dec_ok, dec_w = wrap_enc(state.dec_slot)
     preparing = state.c_phase == PREPARING
     active = state.c_phase == ACTIVE
-    act2 = active[:, None]
+    prop_ok, prop_w = wrap_enc(
+        jnp.where(active[..., None], state.c_prop_slot, NULL)
+    )
+    meta = (
+        acc_d
+        | (acc_w << _ACC_SHIFT)
+        | (dec_w << _DEC_SHIFT)
+        | (prop_w << _PROP_SHIFT)
+    )
+    coord = jnp.where(
+        preparing, state.c_bal,
+        jnp.where(active, state.c_bal | jnp.int32(-(2 ** 31)), NULL),
+    )
     return Blob(
         tag=state.tag,
         bal=state.bal,
         exec_slot=state.exec_slot,
-        acc_bal=state.acc_bal,
-        acc_vid=state.acc_vid,
-        acc_slot=state.acc_slot,
-        dec_vid=state.dec_vid,
-        dec_slot=state.dec_slot,
-        prep_bal=jnp.where(preparing, state.c_bal, NULL),
-        prop_bal=jnp.where(active, state.c_bal, NULL),
-        prop_vid=jnp.where(act2, state.c_prop_vid, NULL),
-        prop_slot=jnp.where(act2, state.c_prop_slot, NULL),
+        coord=coord,
+        acc_vid=jnp.where(acc_ok, state.acc_vid, NULL),
+        dec_vid=jnp.where(dec_ok, state.dec_vid, NULL),
+        prop_vid=jnp.where(prop_ok, state.c_prop_vid, NULL),
+        lane_meta=meta,
+    )
+
+
+def _decode_coord(coord):
+    """coord word -> (prep_bal, prop_bal), NULL where not applicable."""
+    prep_bal = jnp.where(coord >= 0, coord, NULL)
+    is_active = (coord < 0) & (coord != NULL)
+    prop_bal = jnp.where(is_active, coord & jnp.int32(0x7FFFFFFF), NULL)
+    return prep_bal, prop_bal
+
+
+def _decode_lanes(meta, bal, exec_slot, lanes, kbits):
+    """One sender's lane planes from its meta + [.. ] anchors.
+
+    Returns (acc_bal, acc_slot, dec_slot, prop_slot), each ``[..., W]``
+    with NULL for empty/dropped lanes.  Works for a single row ([G, W])
+    and for whole batched blobs ([R, G, W]) alike."""
+    d = meta & _META_DELTA_MASK
+    aw = (meta >> _ACC_SHIFT) & _WRAP_MASK
+    dw = (meta >> _DEC_SHIFT) & _WRAP_MASK
+    pw = (meta >> _PROP_SHIFT) & _WRAP_MASK
+    ebase = (exec_slot >> kbits)[..., None]
+
+    def wrap_dec(w):
+        s = ((ebase + (w - WRAP_BIAS)) << kbits) | lanes
+        return jnp.where(w != 0, s, NULL)
+
+    acc_bal = jnp.where(d != 0, bal[..., None] - (d - 1), NULL)
+    return acc_bal, wrap_dec(aw), wrap_dec(dw), wrap_dec(pw)
+
+
+def expand_blob(blob: Blob) -> ExpandedBlob:
+    """Decode a compact blob (single [G, ...] or batched [R, G, ...]) back
+    to the absolute-plane view.  ``compact -> expand`` is the identity on
+    every representable lane (the codec round-trip property test)."""
+    W = blob.lane_meta.shape[-1]
+    kbits = W.bit_length() - 1
+    lanes = jnp.arange(W, dtype=jnp.int32)
+    acc_bal, acc_slot, dec_slot, prop_slot = _decode_lanes(
+        blob.lane_meta, blob.bal, blob.exec_slot, lanes, kbits
+    )
+    prep_bal, prop_bal = _decode_coord(blob.coord)
+    return ExpandedBlob(
+        tag=blob.tag, bal=blob.bal, exec_slot=blob.exec_slot,
+        acc_bal=acc_bal, acc_vid=blob.acc_vid, acc_slot=acc_slot,
+        dec_vid=blob.dec_vid, dec_slot=dec_slot,
+        prep_bal=prep_bal, prop_bal=prop_bal,
+        prop_vid=blob.prop_vid, prop_slot=prop_slot,
     )
 
 
@@ -222,7 +378,7 @@ def _mix(h, vid):
 
 def step(
     state: EngineState,
-    g: Blob,                 # gathered blobs, every leaf with leading [R] axis
+    g: Blob,                 # gathered COMPACT blobs, every leaf with leading [R] axis
     heard: jnp.ndarray,      # [R] bool — which peers' blobs are live
     req_vid: jnp.ndarray,    # [G, K] new request value-ids (left-packed, NULL pad)
     want_coord: jnp.ndarray, # [G] bool — host FD election trigger
@@ -241,6 +397,11 @@ def step(
         # hard error (not an assert): under python -O a silent bitmask with
         # a non-power-of-two W would map slots to wrong ring lanes
         raise ValueError(f"window must be a power of two, got {W}")
+    if K > W:
+        # K consecutive admission candidates must map to distinct ring
+        # lanes; beyond W they collide and placements would overwrite
+        raise ValueError(f"req_lanes ({K}) must not exceed window ({W})")
+    kbits = W.bit_length() - 1
     my_id = _i32(my_id)
     rids = jnp.arange(R, dtype=jnp.int32)
     lanes = jnp.arange(W, dtype=jnp.int32)
@@ -255,7 +416,6 @@ def step(
     # joiner) is not part of this instance's consensus
     same_inst = g.tag == state.tag[None, :]               # [R, G]
     live = heard[:, None] & in_group & same_inst          # [R, G]
-    live3 = live[:, :, None]                              # [R, G, 1]
 
     inert = state.member_mask == 0
     maj = state.majority
@@ -266,25 +426,83 @@ def step(
     i_member = ((state.member_mask >> my_id) & 1) == 1
 
     # ---- 1. promise update (handlePrepare / acceptAndUpdateBallot) ----
-    in_prep = jnp.where(live, g.prep_bal, NULL)
-    in_prop = jnp.where(live, g.prop_bal, NULL)
+    prep_bal_g, prop_bal_g = _decode_coord(g.coord)       # [R, G]
+    in_prep = jnp.where(live, prep_bal_g, NULL)
+    in_prop = jnp.where(live, prop_bal_g, NULL)
     max_prop = in_prop.max(axis=0)                        # [G]
     new_bal = jnp.maximum(state.bal, jnp.maximum(in_prep.max(axis=0), max_prop))
 
+    exec2 = state.exec_slot[:, None]
+
+    # ---- 2+3. the peer fold: accept-winner select, learn, decision-ring
+    # merge — ONE sequential pass over the R gathered rows with [G, W]
+    # carries (see the transient note in the module docstring).  Each
+    # iteration decodes exactly one peer's compact lane planes.
+    #
+    # Ballots encode the coordinator id, so at most ONE live row publishes
+    # max_prop — folding a masked max over winning rows IS that row's
+    # window (no argmax+gather; see the TPU lowering note).
+    win_row = (in_prop == max_prop[None, :]) & (max_prop[None, :] != NULL)
+
+    def _row(x, r):
+        return lax.dynamic_index_in_dim(x, r, 0, keepdims=False)
+
+    def _decode_row(r):
+        return _decode_lanes(
+            _row(g.lane_meta, r), _row(g.bal, r), _row(g.exec_slot, r),
+            lanes, kbits,
+        )
+
+    nullw = jnp.full((G, W), NULL, jnp.int32)
+
+    def fold_peers(r, carry):
+        (p_slot, p_vid, s_c, b_c, det_vid, n_match, c1_s, c1_v) = carry
+        a_bal, a_slot, d_slot, pr_slot = _decode_row(r)
+        a_vid = _row(g.acc_vid, r)
+        d_vid = _row(g.dec_vid, r)
+        pr_vid = _row(g.prop_vid, r)
+        live_r = _row(live, r)[:, None]                   # [G, 1]
+        # accept winner: adopt the max-prop row's proposal window
+        w_r = _row(win_row, r)[:, None]
+        p_slot = jnp.maximum(p_slot, jnp.where(w_r, pr_slot, NULL))
+        p_vid = jnp.maximum(p_vid, jnp.where(w_r, pr_vid, NULL))
+        # learn: running lexicographic (slot, ballot) max per lane with a
+        # count of rows matching the current max — equal (slot, ballot)
+        # implies equal value (one coordinator per ballot), so keeping the
+        # first-seen vid == the reference's masked-max over matching rows
+        ok = live_r & (a_slot != NULL)
+        s_r = jnp.where(ok, a_slot, NULL)
+        b_r = jnp.where(ok, a_bal, NULL)
+        better = ok & ((s_r > s_c) | ((s_r == s_c) & (b_r > b_c)))
+        same = ok & (s_r == s_c) & (b_r == b_c)
+        n_match = jnp.where(better, 1, n_match + same.astype(jnp.int32))
+        s_c = jnp.where(better, s_r, s_c)
+        b_c = jnp.where(better, b_r, b_c)
+        det_vid = jnp.where(better, a_vid, det_vid)
+        # decision-ring merge: keep the SMALLEST needed decided slot >= my
+        # frontier (rows at the min slot decided the SAME slot => same value)
+        okd = live_r & (d_slot != NULL) & (d_slot >= exec2)
+        lower = okd & (d_slot < c1_s)
+        c1_s = jnp.where(lower, d_slot, c1_s)
+        c1_v = jnp.where(lower, d_vid, c1_v)
+        return (p_slot, p_vid, s_c, b_c, det_vid, n_match, c1_s, c1_v)
+
+    (p_slot, p_vid, s_c, b_c, det_vid, n_match, c1_s, c1_v) = lax.fori_loop(
+        0, R, fold_peers,
+        (
+            nullw, nullw,                                  # accept winner
+            nullw, nullw, nullw, jnp.zeros((G, W), jnp.int32),  # learn
+            jnp.full((G, W), _BIG, jnp.int32), nullw,      # decision merge
+        ),
+    )
+    detected = (n_match >= maj[:, None]) & (s_c != NULL)
+
     # ---- 2. accept (handleAccept, PaxosAcceptor.acceptAndUpdateBallot) ----
     # Highest-ballot proposer wins; its ballot must equal the new promise.
-    # Ballots encode the coordinator id, so at most ONE live row publishes
-    # max_prop — the masked max over winning rows IS that row's window
-    # (no argmax+gather; see the TPU lowering note in the module docstring).
-    win3 = ((in_prop == max_prop[None, :]) & (max_prop[None, :] != NULL))[:, :, None]
-    p_slot = jnp.where(win3, g.prop_slot, NULL).max(axis=0)   # [G, W]
-    p_vid = jnp.where(win3, g.prop_vid, NULL).max(axis=0)
     acc_ok = (max_prop == new_bal) & (max_prop != NULL) & (state.stopped == 0)
-    exec2 = state.exec_slot[:, None]
-    in_win = (
-        (p_slot >= exec2) & (p_slot < exec2 + W) & (p_vid != NULL)
-        & (lane_of(p_slot) == lanes[None, :])             # ring-residue sanity
-    )
+    # no ring-residue check needed: compact decode reconstructs every slot
+    # as (epoch << kbits) | lane, so residue matches its lane by construction
+    in_win = (p_slot >= exec2) & (p_slot < exec2 + W) & (p_vid != NULL)
     do_acc = acc_ok[:, None] & in_win
     acc_bal = jnp.where(do_acc, max_prop[:, None], state.acc_bal)
     acc_vid = jnp.where(do_acc, p_vid, state.acc_vid)
@@ -297,18 +515,6 @@ def step(
     )
 
     # ---- 3. learn (the BatchedAcceptReply->DECISION collapse) ----
-    ga_slot = jnp.where(live3, g.acc_slot, NULL)          # [R, G, W]
-    ga_bal = jnp.where(live3, g.acc_bal, NULL)
-    s_c = ga_slot.max(axis=0)                             # [G, W] newest slot per lane
-    match_s = (ga_slot == s_c[None]) & (s_c[None] != NULL) & live3
-    b_c = jnp.where(match_s, ga_bal, NULL).max(axis=0)    # [G, W]
-    match = match_s & (ga_bal == b_c[None])
-    n_match = match.sum(axis=0)                           # [G, W]
-    detected = (n_match >= maj[:, None]) & (s_c != NULL)
-    # matching rows agree on (slot, ballot) => same value (one coordinator
-    # per ballot): masked max == "any matching row"
-    det_vid = jnp.where(match, g.acc_vid, NULL).max(axis=0)
-
     # Decision candidates per lane: keep the SMALLEST undecided-needed slot
     # >= my frontier (so a lane never skips past an unexecuted decision).
     def cand(slot, vid, valid):
@@ -316,12 +522,6 @@ def step(
         return jnp.where(ok, slot, _BIG), vid
 
     c0_s, c0_v = cand(state.dec_slot, state.dec_vid, True)
-    gd_slot = jnp.where(live3, g.dec_slot, NULL)
-    gd_ok = (gd_slot != NULL) & (gd_slot >= exec2[None])
-    gd_s = jnp.where(gd_ok, gd_slot, _BIG)
-    c1_s = gd_s.min(axis=0)                               # [G, W]
-    # rows at the min slot decided the SAME slot => same decided value
-    c1_v = jnp.where(gd_s == c1_s[None], g.dec_vid, NULL).max(axis=0)
     c2_s, c2_v = cand(s_c, det_vid, detected)
 
     best = jnp.minimum(jnp.minimum(c0_s, c1_s), c2_s)
@@ -335,26 +535,33 @@ def step(
 
     # ---- 4. execute: advance the in-order frontier (EEC analog,
     # PaxosInstanceStateMachine.extractExecuteAndCheckpoint:1511-1593) ----
-    # A lane holds frontier+o exactly when its decided slot equals it, so
-    # the lane->offset rotation is a [W, W] one-hot match, not a gather.
-    slot_o = exec2 + lanes[None, :]                       # [G, W] frontier..+W
-    eq_o = dec_slot[:, :, None] == slot_o[:, None, :]     # [G, Wlane, Woff]
-    d_hit = eq_o.any(axis=1)                              # [G, Woff]
-    d_vid_at = jnp.where(eq_o, dec_vid[:, :, None], NULL).max(axis=1)
-    run = jnp.cumprod(d_hit.astype(jnp.int32), axis=1)
-    n_adv = run.sum(axis=1)                               # [G]
-    exec_new = state.exec_slot + n_adv
-
+    # A lane holds frontier+o exactly when its decided slot equals it —
+    # checked per offset with [G, W] temporaries (a static W unroll; the
+    # [G, W, W] one-hot this replaces was a 4 GB transient at G=1M/W=32).
     h = state.app_hash
     n_execd = state.n_execd
     stop_seen = jnp.zeros((G,), bool)
+    run_prev = jnp.ones((G,), bool)
+    n_adv = jnp.zeros((G,), jnp.int32)
+    run_cols = []
+    vid_cols = []
     for o in range(W):  # static unroll; W small
-        take = run[:, o] > 0
-        vid_o = d_vid_at[:, o]
+        slot_o = state.exec_slot + o
+        eq = dec_slot == slot_o[:, None]                  # [G, W]
+        hit = eq.any(axis=1)
+        vid_o = jnp.where(eq, dec_vid, NULL).max(axis=1)  # [G]
+        take = run_prev & hit
         real = take & (vid_o > 0)
         h = jnp.where(real, _mix(h, vid_o), h)
         n_execd = n_execd + real.astype(jnp.int32)
         stop_seen = stop_seen | (take & ((vid_o & STOP_BIT) != 0))
+        n_adv = n_adv + take.astype(jnp.int32)
+        run_cols.append(take)
+        vid_cols.append(vid_o)
+        run_prev = take
+    exec_new = state.exec_slot + n_adv
+    run = jnp.stack(run_cols, axis=1)                     # [G, W] bool
+    d_vid_at = jnp.stack(vid_cols, axis=1)                # [G, W]
     stopped = jnp.maximum(state.stopped, stop_seen.astype(jnp.int32))
 
     # Majority-rank execute frontier: the slot that >= majority of replicas
@@ -399,24 +606,31 @@ def step(
     n_promise = promised.sum(axis=0) + 1
     quorum = (phase == PREPARING) & (n_promise >= maj)
 
-    # Carryover (the one genuinely sparse flow in the reference — here a
-    # lane-wise lexicographic max over promisers' atomic (ballot, window)
-    # snapshots, two-stage to stay in int32: max slot per lane first, then
-    # max ballot among rows showing that slot.  My own post-accept window
-    # joins as the self-promise row.
-    pa_ok = promised[:, :, None] & (ga_slot != NULL) & (ga_slot >= exec2[None])
+    # Carryover (the one genuinely sparse flow in the reference — a
+    # lane-wise lexicographic (slot, ballot) max over promisers' atomic
+    # snapshots (newest slot wins the lane; ballot breaks ties), folded one
+    # peer row at a time like the learn pass; my own post-accept window
+    # joins as the self-promise row after the fold).
+    def fold_carryover(r, carry):
+        co_slot, co_bal, co_vid = carry
+        a_bal, a_slot, _d, _p = _decode_row(r)
+        a_vid = _row(g.acc_vid, r)
+        ok = _row(promised, r)[:, None] & (a_slot != NULL) & (a_slot >= exec2)
+        better = ok & ((a_slot > co_slot) | ((a_slot == co_slot) & (a_bal > co_bal)))
+        co_slot = jnp.where(better, a_slot, co_slot)
+        co_bal = jnp.where(better, a_bal, co_bal)
+        co_vid = jnp.where(better, a_vid, co_vid)
+        return co_slot, co_bal, co_vid
+
+    co_slot, co_bal, co_vid = lax.fori_loop(
+        0, R, fold_carryover, (nullw, nullw, nullw)
+    )
     my_ok = (acc_slot != NULL) & (acc_slot >= exec2)
-    all_ok = jnp.concatenate([pa_ok, my_ok[None]], axis=0)        # [R+1, G, W]
-    all_slot = jnp.where(all_ok, jnp.concatenate([g.acc_slot, acc_slot[None]], 0), NULL)
-    all_bal = jnp.where(all_ok, jnp.concatenate([g.acc_bal, acc_bal[None]], 0), NULL)
-    all_vid = jnp.concatenate([g.acc_vid, acc_vid[None]], axis=0)
-    co_slot = all_slot.max(axis=0)                                # [G, W]
-    at_max = all_ok & (all_slot == co_slot[None])
-    co_bal = jnp.where(at_max, all_bal, NULL).max(axis=0)
-    pick = at_max & (all_bal == co_bal[None])
+    mine = my_ok & ((acc_slot > co_slot) | ((acc_slot == co_slot) & (acc_bal > co_bal)))
+    co_slot = jnp.where(mine, acc_slot, co_slot)
+    co_bal = jnp.where(mine, acc_bal, co_bal)
+    co_vid = jnp.where(mine, acc_vid, co_vid)
     co_has = co_slot != NULL
-    # picked rows agree on (slot, ballot) => same accepted value
-    co_vid = jnp.where(pick, all_vid, NULL).max(axis=0)
 
     won = quorum
     phase = jnp.where(won, ACTIVE, phase)
@@ -483,28 +697,30 @@ def step(
     # the majority window (don't outrun a majority's rings) and free lanes.
     # c_next must never lag the frontier (a recovered snapshot can be a few
     # slots behind the replayed decisions — proposing at an already-decided
-    # slot would silently lose the request).
+    # slot would silently lose the request).  Placement runs as a static K
+    # unroll with [G, W] temporaries; consecutive candidates map to
+    # DISTINCT lanes (K <= W enforced above), so the sequential placement
+    # equals the reference's all-at-once one-hot scatter.
     c_next = jnp.where(is_active, jnp.maximum(c_next, exec_new), c_next)
-    ks = jnp.arange(K, dtype=jnp.int32)
     bound = maj_exec + W
-    cand_slot_k = c_next[:, None] + ks[None, :]           # [G, K]
-    cand_lane = lane_of(cand_slot_k)
-    oh_k = cand_lane[:, :, None] == lanes[None, None, :]  # [G, K, W] one-hot
-    lane_busy = (oh_k & (c_prop_slot != NULL)[:, None, :]).any(axis=2)
-    dec_at_cand = jnp.where(oh_k, dec_slot[:, None, :], NULL).max(axis=2)
-    can_k = (
-        may_admit[:, None] & (no_stop_before > 0)
-        & (req_vid != NULL) & (cand_slot_k < bound[:, None]) & (~lane_busy)
-        & (dec_at_cand != cand_slot_k)   # never re-propose a decided slot
-    )
-    admit = jnp.cumprod(can_k.astype(jnp.int32), axis=1)  # contiguous prefix
-    n_admit = admit.sum(axis=1)                           # [G]
-    onehot = oh_k & (admit[:, :, None] > 0)
-    add_vid = jnp.where(onehot, req_vid[:, :, None], 0).sum(axis=1)
-    add_slot = jnp.where(onehot, cand_slot_k[:, :, None], 0).sum(axis=1)
-    newly = onehot.any(axis=1)
-    c_prop_vid = jnp.where(newly, add_vid, c_prop_vid)
-    c_prop_slot = jnp.where(newly, add_slot, c_prop_slot)
+    adm_prev = jnp.ones((G,), bool)
+    n_admit = jnp.zeros((G,), jnp.int32)
+    for k in range(K):  # static unroll; K small
+        cand_slot = c_next + k                            # [G]
+        oh = lane_of(cand_slot)[:, None] == lanes[None, :]  # [G, W]
+        lane_busy = (oh & (c_prop_slot != NULL)).any(axis=1)
+        dec_at_cand = jnp.where(oh, dec_slot, NULL).max(axis=1)
+        can = (
+            may_admit & (no_stop_before[:, k] > 0)
+            & (req_vid[:, k] != NULL) & (cand_slot < bound) & (~lane_busy)
+            & (dec_at_cand != cand_slot)   # never re-propose a decided slot
+        )
+        adm = adm_prev & can               # contiguous admission prefix
+        place = oh & adm[:, None]
+        c_prop_vid = jnp.where(place, req_vid[:, k][:, None], c_prop_vid)
+        c_prop_slot = jnp.where(place, cand_slot[:, None], c_prop_slot)
+        n_admit = n_admit + adm.astype(jnp.int32)
+        adm_prev = adm
     c_next = c_next + n_admit
 
     new_state = EngineState(
@@ -525,7 +741,7 @@ def step(
     outputs = StepOutputs(
         n_committed=jnp.where(m1, n_adv, 0),
         exec_base=state.exec_slot,
-        exec_vid=jnp.where(m2 & (run > 0), d_vid_at, NULL),
+        exec_vid=jnp.where(m2 & run, d_vid_at, NULL),
         n_admitted=jnp.where(m1, n_admit, 0),
         maj_exec=jnp.where(m1, maj_exec, 0),
         app_hash=new_state.app_hash,
@@ -548,7 +764,7 @@ def step(
 # the slices fuse for free), and the step's outputs + fresh publish blob
 # come back as single vectors split into numpy views on the host.
 #
-# The vector layout intentionally equals the ``C`` wire frame body
+# The vector layout intentionally equals the ``D`` wire frame body
 # (Blob._fields order, C-order ravel): a received frame's payload IS the
 # packed row, byte-for-byte, so the transport needs no re-packing either.
 # ---------------------------------------------------------------------------
@@ -562,7 +778,7 @@ def _leaf_shapes(fields, cfg: EngineConfig):
 
 # [G]-shaped leaves across Blob and StepOutputs (everything else is [G, W])
 _G_LEAVES = frozenset((
-    "tag", "bal", "exec_slot", "prep_bal", "prop_bal",
+    "tag", "bal", "exec_slot", "coord",
     "n_committed", "exec_base", "n_admitted", "maj_exec", "app_hash",
     "bal_new",
 ))
@@ -585,6 +801,12 @@ def out_vec_len(cfg: EngineConfig) -> int:
     return sum(
         int(np.prod(s)) for _n, s in _leaf_shapes(StepOutputs._fields, cfg)
     )
+
+
+def legacy_blob_vec_len(cfg: EngineConfig) -> int:
+    """Int32 words of the pre-compact all-int32 blob layout (5 ``[G]`` +
+    7 ``[G, W]`` planes) — the footprint probe's reduction baseline."""
+    return 5 * cfg.n_groups + 7 * cfg.n_groups * cfg.window
 
 
 def pack_blob(blob: Blob) -> jnp.ndarray:

@@ -26,7 +26,17 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+try:  # jax >= 0.6 exports shard_map at top level (replica-check kwarg renamed)
+    from jax import shard_map as _shard_map
+
+    _SHARD_MAP_CHECK_KW = {"check_vma": False}
+except ImportError:  # jax 0.4/0.5: experimental namespace, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _SHARD_MAP_CHECK_KW = {"check_rep": False}
+
+shard_map = _shard_map
 
 from ..ops.engine import EngineConfig, EngineState, StepOutputs, make_blob, step
 from .mesh import GROUP_AXIS, REPLICA_AXIS
@@ -57,7 +67,7 @@ def build_replica_states(cfg: EngineConfig, coord0=None) -> EngineState:
     ])
 
 
-def single_chip_step(cfg: EngineConfig):
+def single_chip_step(cfg: EngineConfig, donate: bool = True):
     """vmap-over-replicas step on one device.
 
     Takes (states [R,...], req_vid [R,G,K], want_coord [R,G]) and returns
@@ -67,6 +77,12 @@ def single_chip_step(cfg: EngineConfig):
     ``testing/TESTPaxosConfig.java:563-580``); row i masks which peers'
     blobs replica i consumes this step.  None (the default) means full
     delivery.  A replica always hears itself — the diagonal is forced.
+
+    ``donate=True`` (default) aliases the caller's old stacked states into
+    the outputs — halves state HBM (the G=2M capacity lever; a no-op on
+    backends that ignore donation) but requires the caller to thread
+    states through every call.  Pass ``donate=False`` for a step whose
+    input states stay valid across calls (e.g. reusable example args).
     """
     R = cfg.n_replicas
     my_ids = jnp.arange(R, dtype=jnp.int32)
@@ -74,7 +90,7 @@ def single_chip_step(cfg: EngineConfig):
     def _one(state, gathered, heard_row, req, want, my_id):
         return step(state, gathered, heard_row, req, want, my_id, cfg)
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=(0,) if donate else ())
     def run(states, req_vid, want_coord, heard=None):
         h = jnp.ones((R, R), bool) if heard is None else (
             jnp.asarray(heard, bool) | jnp.eye(R, dtype=bool)
@@ -121,11 +137,14 @@ def spmd_step(cfg: EngineConfig, mesh: Mesh):
             P(REPLICA_AXIS, None),
         ),
         out_specs=(state_spec, out_spec),
-        check_vma=False,
+        **_SHARD_MAP_CHECK_KW,
     )
     def _sharded(states, req_vid, want_coord, heard):
         # local shapes: leaves [1, G_loc, ...]; heard [1, R]
         state = jax.tree.map(lambda x: x[0], states)
+        # the exchange payload is the COMPACT blob (4 [G] + 4 [G, W] int32
+        # leaves vs the state's 12 + 7): the all_gather moves ~42% fewer
+        # ICI bytes per step than the pre-compact layout
         blob = make_blob(state)
         gathered = jax.tree.map(lambda x: lax.all_gather(x, REPLICA_AXIS), blob)
         my_id = lax.axis_index(REPLICA_AXIS).astype(jnp.int32)
@@ -137,7 +156,8 @@ def spmd_step(cfg: EngineConfig, mesh: Mesh):
         expand = lambda x: x[None]
         return jax.tree.map(expand, new_state), jax.tree.map(expand, out)
 
-    fn = jax.jit(_sharded)
+    # donate the global state shards (see single_chip_step)
+    fn = jax.jit(_sharded, donate_argnums=(0,))
 
     def run(states, req_vid, want_coord, heard=None):
         if heard is None:

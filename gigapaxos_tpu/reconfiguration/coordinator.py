@@ -165,21 +165,15 @@ class PaxosReplicaCoordinator(AbstractReplicaCoordinator):
         stop: bool = False,
         request_id: Optional[int] = None,
     ) -> bool:
-        is_coord = getattr(self.app, "is_coordinated", None)
-        if not stop and is_coord is not None and not is_coord(value):
-            # uncoordinated local execution (linwrites local reads, ref
-            # ``LinWritesLocReadsApp.java:26-44``): never enters consensus,
-            # answers from THIS replica's state.  No dedup entry — a
-            # re-sent read just re-reads.
-            if self.manager.names.get(name) is None:
-                return False
-            from ..manager import SlimRequest
+        if not stop:
+            from ..manager import execute_uncoordinated
 
-            req = SlimRequest(name, int(request_id or 0), value)
-            self.app.execute(req, do_not_reply_to_client=False)
-            if callback is not None:
-                callback(request_id, getattr(req, "response_value", None))
-            return True
+            handled = execute_uncoordinated(
+                self.app, self.manager.names, name, value, request_id,
+                callback,
+            )
+            if handled is not None:
+                return handled
         vid = self.manager.propose(
             name, value, callback=callback, stop=stop, request_id=request_id
         )

@@ -612,8 +612,13 @@ class Reconfigurator:
         self, name: str, epoch: int, stragglers: List[int]
     ) -> None:
         prev = self._unfinished_drops.get((name, epoch))
+        # preserve the previous attempt timestamp: resetting it to 0.0
+        # made the post-budget slow cadence (`_redrive_unfinished_drops`'s
+        # audit-period gate) always appear expired, turning the bounded
+        # fallback into continuous retransmits
         self._unfinished_drops[(name, epoch)] = (
-            list(stragglers), prev[1] if prev else 0, 0.0
+            list(stragglers), prev[1] if prev else 0,
+            prev[2] if prev else 0.0,
         )
 
     def _redrive_unfinished_drops(self) -> None:
@@ -891,13 +896,27 @@ class Reconfigurator:
             key_prefix == "#rc" and kind == "remove_reconfigurator"
             and nid == self.my_id
         )
+        # `fwd` carries the ids that already held (and could not own) this
+        # op: each hop adds itself and only unvisited RCs are candidates,
+        # so the forward chain is bounded by the RC set — two RCs that
+        # each consider themselves unable to own the op (e.g. both still
+        # bootstrapping the record RSM) can no longer ping-pong the frame
+        # forever, yet the op still reaches a capable THIRD node instead
+        # of dying at the second
         if self.rc_manager.names.get(RC_GROUP) is None or removes_me:
+            visited = set(body.get("fwd") or ()) | {self.my_id}
             for rc in self._rc_set():
-                if rc == self.my_id or not self.is_node_up(rc):
+                if rc in visited or not self.is_node_up(rc):
                     continue
-                self.send(("RC", int(rc)), kind, body)
+                if key_prefix == "#rc" and kind == "remove_reconfigurator" \
+                        and rc == nid:
+                    continue  # the removal target cannot own its own ack
+                self.send(
+                    ("RC", int(rc)), kind, dict(body, fwd=sorted(visited))
+                )
                 return None
-            # no live peer to forward to: fall through and try locally
+            # every live candidate already saw this op (or none is live):
+            # fall through and try locally
         if body.get("client") is not None:
             self._pending_clients.setdefault(
                 f"{key_prefix}:{kind}:{nid}", []

@@ -24,7 +24,7 @@ from typing import Callable, Dict, Optional, Tuple
 import numpy as np
 
 from .failure_detection import FailureDetector
-from .manager import PaxosManager
+from .manager import PaxosManager, execute_uncoordinated
 from .net.codec import (
     decode_blob_vec,
     decode_json,
@@ -143,6 +143,7 @@ class PaxosServer:
         self.CHUNK_BYTES = 512 * 1024
         self.CHUNK_PACE_S = 0.002  # per-chunk stagger: lets other frames in
         self._xfer_seq = 0
+        self._schema_skew_warned: set = set()
         self._chunk_lock = threading.Lock()
         # (sender, xfer id) -> {"n": total, "parts": {i: bytes}, "t": time}
         self._chunk_rx: Dict[Tuple[int, str], Dict] = {}
@@ -194,7 +195,25 @@ class PaxosServer:
     # ---- message ingress (demultiplexer analog) ------------------------
     def _on_message(self, payload: bytes, peer: Tuple[str, int], reply) -> None:
         kind = decode_kind(payload)
-        if kind == "C":
+        if kind not in ("D", "J"):
+            # frame from a DIFFERENT schema (pre-tag "B", pre-compact "C",
+            # or anything newer): parsing a fixed-layout blob misaligned
+            # would feed garbage ballots into consensus, so drop it LOUDLY
+            # — once per kind, not per tick (a skewed peer republishes
+            # continuously), and for unknown kinds too (an upgraded peer
+            # must not be swallowed silently as a JSON decode error)
+            if kind not in self._schema_skew_warned:
+                self._schema_skew_warned.add(kind)
+                import sys
+
+                print(
+                    f"paxos-server-{self.my_id}: dropping frame of "
+                    f"unrecognized schema {kind!r} (this node speaks "
+                    "'D'/'J'; a mixed-version peer must be upgraded)",
+                    file=sys.stderr, flush=True,
+                )
+            return
+        if kind == "D":
             sender, _tick, vec = decode_blob_vec(payload, self.cfg)
             with self._blob_lock:
                 self._peer_blobs[sender] = vec
@@ -342,10 +361,23 @@ class PaxosServer:
                 "t_ingress", time.monotonic() - t0
             )
 
+    def _maybe_local_read(self, name: str, value: str, request_id,
+                          cb) -> bool:
+        """Uncoordinated-request fast path (`manager.py:
+        execute_uncoordinated`).  Returns False (caller proposes
+        normally) when the app doesn't route, the request is coordinated,
+        or the name isn't hosted here (the coordinated path owes the
+        unknown-name error)."""
+        return execute_uncoordinated(
+            self.manager.app, self.manager.names, name, value, request_id,
+            cb,
+        ) is True
+
     def _on_client_batch(self, reqs, reply) -> None:
         """Batched-frame ingress: one propose_batch call for the whole
-        frame (stops and overload shedding peel off to the singleton
-        path; everything else amortizes the lock/clock per frame)."""
+        frame (stops, local reads, and overload shedding peel off to
+        their own paths; everything else amortizes the lock/clock per
+        frame)."""
         t0 = time.monotonic()
         m = self.manager
         overloaded = m.overloaded()
@@ -356,18 +388,22 @@ class PaxosServer:
                 continue
             request_id = int(sub["request_id"])
             name = sub["name"]
-            if overloaded and request_id not in m.response_cache:
-                self._buffer_response(reply, {
-                    "request_id": request_id, "response": None,
-                    "name": name, "error": "overload",
-                })
-                continue
 
             def cb(rid, response, _name=name):
                 self._buffer_response(reply, {
                     "request_id": rid, "response": response, "name": _name,
                 })
 
+            if self._maybe_local_read(
+                name, sub.get("value", ""), request_id, cb
+            ):
+                continue
+            if overloaded and request_id not in m.response_cache:
+                self._buffer_response(reply, {
+                    "request_id": request_id, "response": None,
+                    "name": name, "error": "overload",
+                })
+                continue
             items.append((name, sub.get("value", ""), request_id, cb))
         if items:
             results = m.propose_batch(items)
@@ -379,11 +415,25 @@ class PaxosServer:
                         "request_id": rid, "response": None,
                         "name": name, "error": "unknown_name",
                     })
+                elif outcome == "exhausted":
+                    # vid counter space ran out for THIS item; cached and
+                    # in-flight items in the same frame were still served
+                    self._buffer_response(reply, {
+                        "request_id": rid, "response": None,
+                        "name": name, "error": "exhausted",
+                    })
         DelayProfiler.update_count("t_ingress", time.monotonic() - t0)
 
     def _on_client_request_inner(self, body: Dict, reply) -> None:
         request_id = int(body["request_id"])
         name = body["name"]
+        if not body.get("stop") and self._maybe_local_read(
+            name, body.get("value", ""), request_id,
+            lambda rid, response: self._buffer_response(reply, {
+                "request_id": rid, "response": response, "name": name,
+            }),
+        ):
+            return
         if self.manager.overloaded() and \
                 request_id not in self.manager.response_cache:
             # MAX_OUTSTANDING_REQUESTS back-pressure: shed at the entry

@@ -269,8 +269,16 @@ def run_soak(
             if rec is None or rec.deleted:
                 # poll: a straggler that missed the drop (it could not
                 # ack while its stop was un-executed) heals through the
-                # audit-cadence redrop — give that machinery a window
-                for _ in range(600):
+                # audit-cadence redrop — give that machinery a window.
+                # Deadline-bound like the READY align loop below: the
+                # post-budget redrops fire at most once per audit period
+                # (wall-timer-gated), so a step-count cap alone can burn
+                # through on a fast box before the timers the heal needs
+                # have fired
+                drop_deadline = time.time() + 6 * max(
+                    rc.ready_audit_period_s for rc in c.reconfigurators
+                )
+                while time.time() < drop_deadline:
                     if all(m.names.get(nm) is None for m in c.ars.managers):
                         break
                     step()

@@ -1,0 +1,89 @@
+"""Static memory-footprint probe for the engine's exchange + step.
+
+Prints ONE JSON line with the blob bytes/replica (compact ``D`` layout vs
+the pre-compact all-int32 layout), the engine state bytes, and a peak
+step-transient estimate for a given (G, W, K, R) — pure arithmetic over
+the engine's leaf tables, so CI and CPU-only rounds can assert the HBM
+budget without a TPU.
+
+Usage:
+    python scripts/footprint_probe.py [--groups G] [--window W]
+                                      [--req-lanes K] [--replicas R]
+
+Defaults are the headline bench shape (G=1,048,576, W=32, K=16, R=3).
+
+The transient model: the step's cross-replica reductions fold one peer
+row at a time with [G, W] carries (11 planes across the two folds), the
+per-row decode materializes ~7 more, and the execute/admission unrolls
+plus the under-construction new state and outputs hold ~12 — call it
+~30 live [G, W] int32 planes at the worst program point, plus the [R, N]
+gathered compact rows and (with buffer donation) ONE state copy.  That
+is an upper-bound envelope, not a measurement: the pre-compact step
+additionally materialized [R, G, W] and [R+1, G, W] masked intermediates
+and a [G, W, W] execute one-hot (~8 GB at G=1M/W=32/R=3), which is the
+delta this probe exists to keep honest.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# ~live [G, W] int32 planes at the step's worst point (see module docstring)
+TRANSIENT_LANE_PLANES = 30
+# EngineState: 12 [G] + 7 [G, W] int32 leaves (ops/engine.py:EngineState)
+STATE_G_LEAVES = 12
+STATE_GW_LEAVES = 7
+
+
+def probe(G: int, W: int, K: int, R: int) -> dict:
+    from gigapaxos_tpu.ops.engine import (
+        EngineConfig,
+        blob_vec_len,
+        legacy_blob_vec_len,
+        out_vec_len,
+    )
+
+    cfg = EngineConfig(n_groups=G, window=W, req_lanes=K, n_replicas=R)
+    blob_b = 4 * blob_vec_len(cfg)
+    legacy_b = 4 * legacy_blob_vec_len(cfg)
+    state_b = 4 * (STATE_G_LEAVES * G + STATE_GW_LEAVES * G * W)
+    gathered_b = R * blob_b
+    transient_b = 4 * TRANSIENT_LANE_PLANES * G * W
+    out_b = 4 * out_vec_len(cfg)
+    # single-chip bench hosts all R replica states + the shared gathered
+    # rows + one stepping replica's transients (vmap serializes per XLA
+    # scheduling at this size; use R as the conservative upper bound)
+    single_chip_peak_b = R * state_b + gathered_b + R * transient_b + R * out_b
+    return {
+        "shape": {"G": G, "W": W, "K": K, "R": R},
+        "blob_bytes_per_replica": blob_b,
+        "blob_bytes_per_group": round(blob_b / G, 1),
+        "legacy_blob_bytes_per_replica": legacy_b,
+        "blob_reduction_pct": round(100.0 * (1 - blob_b / legacy_b), 1),
+        "state_bytes_per_replica": state_b,
+        "gathered_rows_bytes": gathered_b,
+        "step_transient_estimate_bytes": transient_b,
+        "single_chip_peak_estimate_bytes": single_chip_peak_b,
+        "single_chip_peak_estimate_gib": round(
+            single_chip_peak_b / 2 ** 30, 2
+        ),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--groups", "-G", type=int, default=1_048_576)
+    ap.add_argument("--window", "-W", type=int, default=32)
+    ap.add_argument("--req-lanes", "-K", type=int, default=16)
+    ap.add_argument("--replicas", "-R", type=int, default=3)
+    args = ap.parse_args()
+    print(json.dumps(probe(args.groups, args.window, args.req_lanes,
+                           args.replicas)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,6 +23,14 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-run soak tests excluded from the tier-1 gate "
+        "(-m 'not slow')",
+    )
+
+
 @pytest.fixture(autouse=True)
 def _clear_config():
     from gigapaxos_tpu.utils.config import Config

@@ -225,4 +225,16 @@ def test_propose_batch_outcomes():
     res = m.propose_batch([("b", "v0", rid, lambda r, resp: got.append(resp))])
     assert res[0][1] == "cached" and res[0][2] == first_resp
     assert got[-1] == first_resp
+
+    # vid-counter exhaustion fails PER ITEM: cached entries in the same
+    # frame still answer (no whole-frame raise, no discarded responses)
+    from gigapaxos_tpu.manager import VID_COUNTER_MASK
+
+    m._next_counter = VID_COUNTER_MASK + 1
+    res = m.propose_batch([
+        ("b", "v0", rid, lambda r, resp: got.append(resp)),
+        ("b", "fresh", rid + 7, None),
+    ])
+    assert [r[1] for r in res] == ["cached", "exhausted"]
+    assert got[-1] == first_resp
     c.close()

@@ -3,7 +3,6 @@ async client — parity with the reference's ``tests/loopback_1_group``
 smoke (3 actives on 127.0.0.1, client drives requests) and the failover
 scenario (BASELINE config 5)."""
 
-import socket
 import time
 
 import numpy as np
@@ -18,16 +17,8 @@ from gigapaxos_tpu.server import PaxosServer
 CFG = EngineConfig(n_groups=6, window=8, req_lanes=4, n_replicas=3)
 
 
-def free_ports(n):
-    socks, ports = [], []
-    for _ in range(n):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        socks.append(s)
-        ports.append(s.getsockname()[1])
-    for s in socks:
-        s.close()
-    return ports
+from gigapaxos_tpu.testing.ports import free_ports  # noqa: E402 (headroom
+# for derived ports: client-plane offset / HTTP front ends)
 
 
 def boot_cluster(fd_timeout_s=2.0):
