@@ -135,11 +135,14 @@ def start_ar_http(
     propose: Callable[[str, str, Callable], Optional[int]],
     timeout_s: float = 20.0,
     overloaded: Optional[Callable[[], bool]] = None,
+    metrics: Optional[Callable[[], str]] = None,
 ) -> ThreadingHTTPServer:
     """Mount the active-replica app-request API (HttpActiveReplica analog).
     ``propose(name, value, callback)`` is the manager's propose;
     ``overloaded()`` gates admission (503) so the MAX_OUTSTANDING back
-    -pressure covers every entry path, not just the binary protocol."""
+    -pressure covers every entry path, not just the binary protocol;
+    ``metrics()`` renders the node's engine-metrics registry as text
+    (``GET /metrics``, Prometheus-style — the obs-plane dump endpoint)."""
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):
@@ -153,9 +156,23 @@ def start_ar_http(
             self.end_headers()
             self.wfile.write(data)
 
+        def _respond_text(self, code: int, text: str) -> None:
+            data = text.encode("utf-8")
+            self.send_response(code)
+            self.send_header("Content-Type", "text/plain; charset=utf-8")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
         def do_GET(self):
-            if urlparse(self.path).path == "/stats":
+            path = urlparse(self.path).path
+            if path == "/stats":
                 self._respond(200, {"stats": DelayProfiler.get_stats()})
+            elif path == "/metrics":
+                body = metrics() if metrics is not None else ""
+                # DelayProfiler rides along so one scrape sees both planes
+                body += "# delayprofiler " + DelayProfiler.get_stats() + "\n"
+                self._respond_text(200, body)
             else:
                 self._respond(404, {"error": "POST app requests to /"})
 

@@ -62,6 +62,9 @@ from .ops.engine import (
     step,
     step_host,
 )
+from .obs import gplog
+from .obs.metrics import MetricsRegistry
+from .obs.reqtrace import RequestTracer
 from .ops.lifecycle import create_groups, kill_groups
 from .storage.logger import PaxosLogger
 from .utils.profiler import DelayProfiler
@@ -219,6 +222,17 @@ class PaxosManager:
         self.app = app
         self.cfg = cfg
         G, W, K = cfg.n_groups, cfg.window, cfg.req_lanes
+        # observability plane: structured log, bounded per-request trace
+        # ring (DEBUG-gated; GP_TRACE=1 / GP_LOG=trace:DEBUG), and the
+        # per-step engine metrics registry (always on — per-STEP numpy
+        # reductions, never per-request work)
+        self.log = gplog.node_logger("manager", my_id)
+        self.tracer = RequestTracer(my_id)
+        self.metrics = MetricsRegistry(node=my_id)
+        # host cache of each row's last-known coordinator id (from the
+        # promised ballot) — flip counting reads `bal` only on the rare
+        # ticks where a ballot actually rose (bal_new nonzero)
+        self._coord_cache = np.full(G, -1, np.int32)
 
         # explicit ctor args win; otherwise the three-tier flag system
         # decides (defaults < properties file < env/CLI — PaxosConfig.PC)
@@ -1448,6 +1462,11 @@ class PaxosManager:
                 self.row_activity[row] = time.time()
                 self.demand_counts[name] = self.demand_counts.get(name, 0) + 1
                 self.demand_backlog += 1
+                if self.tracer.enabled:
+                    self.tracer.note(
+                        request_id, "propose", name=name, node=self.my_id,
+                        vid=vid, row=row, entry=entry, stop=bool(stop),
+                    )
         if emulated is not None:
             counter, request_id = emulated
             req = SlimRequest(name, request_id, request_value)
@@ -1473,6 +1492,9 @@ class PaxosManager:
                 callback(request_id, response)
             return None
         if cached_hit:
+            if self.tracer.enabled:
+                self.tracer.note(request_id, "respond-cached", name=name,
+                                 node=self.my_id)
             if callback:
                 callback(request_id, cached_response)
             return None
@@ -1523,6 +1545,7 @@ class PaxosManager:
         fired: List[Tuple[Callable, int, Optional[str]]] = []
         now = time.time()
         default_entry = self.my_id if entry_replica is None else entry_replica
+        tr_on = self.tracer.enabled
         with self._state_lock:
             versions = self._np("version")
             names, cache = self.names, self.response_cache
@@ -1571,6 +1594,11 @@ class PaxosManager:
                 self.demand_counts[name] = self.demand_counts.get(name, 0) + 1
                 self.demand_backlog += 1
                 results.append((rid, "queued", None))
+                if tr_on:
+                    self.tracer.note(
+                        rid, "propose", name=name, node=self.my_id,
+                        vid=vid, row=row, entry=entry, batch=True,
+                    )
         for cb, rid, resp in fired:
             cb(rid, resp)
         return results
@@ -1669,6 +1697,12 @@ class PaxosManager:
                 # executing in the new epoch diverges the RSM (chaos
                 # soak); genuine client requests retransmit
                 return
+            if self.tracer.enabled:
+                self.tracer.note(
+                    body.get("request_id"), "forward-in",
+                    name=body["name"], node=self.my_id,
+                    entry=body.get("entry"),
+                )
             self.propose(
                 body["name"], body["value"],
                 stop=body.get("stop", False),
@@ -1685,6 +1719,10 @@ class PaxosManager:
             if self.current_epoch(body["name"]) != int(body["epoch"]):
                 return
             name = body["name"]
+            if self.tracer.enabled:
+                for rid, entry, _v, _s in body["reqs"]:
+                    self.tracer.note(rid, "forward-in", name=name,
+                                     node=self.my_id, entry=entry)
             items = []
             for rid, entry, value, stop in body["reqs"]:
                 if stop:
@@ -1873,6 +1911,12 @@ class PaxosManager:
                     self.vid_meta.pop(vid, None)
                     self.vid_scope.pop(vid, None)
                 if reqs:
+                    if self.tracer.enabled:
+                        for rid, _e, _v, _s in reqs:
+                            self.tracer.note(
+                                rid, "forward-out", name=name,
+                                node=self.my_id, to=coord,
+                            )
                     self.forward_out.append((coord, "forward_batch", {
                         "name": name, "epoch": epoch_now, "reqs": reqs,
                     }))
@@ -2008,6 +2052,33 @@ class PaxosManager:
             vid = int(out_np.preempted_vid[g_, l_])
             if vid in self.arena and vid not in self.retained:
                 self.queues.setdefault(int(g_), []).append(vid)
+        # per-step engine metrics: aggregate counters reduced from the
+        # vectorized step outputs — a few O(G) numpy sums per TICK (the
+        # engine step itself is ~1ms), never per-request host work
+        mx = self.metrics
+        n_dec = int(out_np.n_committed.sum())
+        if n_dec:
+            mx.count("decisions_executed", n_dec)
+        n_admit = int(out_np.n_admitted.sum())
+        if n_admit:
+            mx.count("requests_admitted", n_admit)
+        if len(pre_g):
+            mx.count("preempts", len(pre_g))
+        if out_np.bal_new.any():
+            # coordinator flips: `bal` is only pulled host-side on the
+            # rare ticks where a promised ballot rose (elections), and
+            # only the risen rows are compared against the cached view
+            pg_m = np.nonzero(out_np.bal_new)[0]
+            new_coord = ballot_coord(self._np("bal")[pg_m]).astype(np.int32)
+            flips = int((new_coord != self._coord_cache[pg_m]).sum())
+            if flips:
+                mx.count("coordinator_flips", flips)
+            self._coord_cache[pg_m] = new_coord
+            mx.count("ballot_rises", len(pg_m))
+        mx.gauge("frontier_stall_groups", len(self._payload_blocked))
+        mx.gauge("inflight_requests", len(self.inflight))
+        mx.gauge("arena_payloads", len(self.arena))
+        mx.observe("engine_step_s", self.last_engine_step_s)
         # payload-retention watermark: min APP-execution cursor over all
         # group members (device frontiers can run ahead of payload-gated
         # app execution — GC'ing on them would strand a parked peer).
@@ -2125,11 +2196,20 @@ class PaxosManager:
             DelayProfiler.update_count("t_journal", time.monotonic() - t_j)
         if len(committed):
             self.row_activity[committed] = time.time()
+        tr = self.tracer
         for g in committed:
             base = int(out_np.exec_base[g])
             pend = self.pending_exec.setdefault(int(g), {})
             for o in range(int(out_np.n_committed[g])):
-                pend[base + o] = int(out_np.exec_vid[g, o])
+                vid = int(out_np.exec_vid[g, o])
+                pend[base + o] = vid
+                if tr.enabled and vid != 0:
+                    meta = self.vid_meta.get(vid)
+                    tr.note(
+                        vid if meta is None or meta[1] == -1 else meta[1],
+                        "decide", name=self.row_name.get(int(g)),
+                        node=self.my_id, row=int(g), slot=base + o, vid=vid,
+                    )
         t_exec = time.monotonic()
         missing = self._drain_pending_exec()
         DelayProfiler.update_delay("app_execute", t_exec)
@@ -2201,8 +2281,8 @@ class PaxosManager:
         request — giving up would silently skip a slot and diverge the
         RSM, so the only alternatives are retry or wedge.  Backoff grows
         1ms -> 100ms; sustained failure surfaces loudly (DelayProfiler
-        counter at /stats + a periodic stderr line) instead of raising
-        into the tick loop."""
+        counter at /stats + a periodic WARNING log line) instead of
+        raising into the tick loop."""
         delay = 0.001
         attempt = 0
         while True:
@@ -2214,13 +2294,10 @@ class PaxosManager:
             attempt += 1
             DelayProfiler.update_count("app_execute_retries")
             if attempt in (10, 100) or attempt % 1000 == 0:
-                import sys as _sys
-
-                print(
-                    f"gigapaxos: app refusing to execute "
-                    f"{req.paxos_id}#{req.request_id} ({attempt} attempts); "
+                self.log.warning(
+                    "app refusing to execute %s#%s (%d attempts); "
                     "retrying forever (node is wedged until it succeeds)",
-                    file=_sys.stderr, flush=True,
+                    req.paxos_id, req.request_id, attempt,
                 )
             time.sleep(delay)
             delay = min(delay * 2, 0.1)
@@ -2264,6 +2341,7 @@ class PaxosManager:
             rc = self.response_cache
             nm = name or ""
             my = self.my_id
+            tr_on = self.tracer.enabled
             for request_id, entry, value in decode_batch(payload):
                 if request_id in rc:
                     if entry == my:
@@ -2276,6 +2354,9 @@ class PaxosManager:
                 req = SlimRequest(nm, request_id, value)
                 self._app_execute_retrying(req, do_not_reply=(entry != my))
                 self.total_executed += 1
+                if tr_on:
+                    self.tracer.note(request_id, "execute", name=nm,
+                                     node=my, row=g, slot=slot, batch=True)
                 self.inflight.pop(request_id, None)
                 response = req.response_value
                 rc[request_id] = (now, response, nm)
@@ -2309,6 +2390,10 @@ class PaxosManager:
         )
         self._app_execute_retrying(req, do_not_reply=(entry != self.my_id))
         self.total_executed += 1
+        if self.tracer.enabled:
+            self.tracer.note(request_id, "execute", name=name or "",
+                             node=self.my_id, row=g, slot=slot,
+                             stop=bool(vid & STOP_BIT))
         self._slots_since_ckpt += 1
         self.inflight.pop(request_id, None)
         response = getattr(req, "response_value", None)

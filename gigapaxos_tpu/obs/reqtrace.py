@@ -1,0 +1,151 @@
+"""Per-request tracing — the ``RequestInstrumenter`` analog.
+
+Ref: ``paxosutil/RequestInstrumenter.java:36-80`` — a static map of
+per-request message logs, populated by ``received()``/``sent()`` calls
+sprinkled through the send/receive paths, all compiled away unless the
+debug flag is on, and dumped on demand to reconstruct one request's
+journey through the system.
+
+Redesign for this runtime: a :class:`RequestTracer` instance PER NODE
+(every test topology runs many nodes in one process, so a static map
+would interleave their timelines) holding a bounded FIFO ring of
+``key -> [(t_monotonic, event, detail)]`` timelines.  Keys are request
+ids on the data plane and ``"epoch:<name>"`` strings on the
+reconfiguration plane.  A secondary bounded index maps service name ->
+recently traced keys so a chaos-soak divergence on a NAME can dump the
+requests that touched it (``testing/chaos.py:_name_diag``).
+
+Gating contract (the hot-path budget): callers check ``tracer.enabled``
+— one attribute read — before composing event details; ``note()`` also
+checks it, so an unguarded call site is correct, just one function call
+less cheap.  When disabled the tracer records nothing and allocates
+nothing.  ``enabled`` defaults from ``GP_TRACE=1`` or a DEBUG-level
+``gp.trace`` logger (``GP_LOG=trace:DEBUG``) at construction; soaks and
+tests flip the attribute directly.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Dict, List, Optional, Tuple
+
+_TRUE = frozenset(("1", "true", "yes", "on"))
+
+
+def trace_enabled() -> bool:
+    """Process-default gate: ``GP_TRACE`` env or ``gp.trace`` at DEBUG."""
+    if os.environ.get("GP_TRACE", "").strip().lower() in _TRUE:
+        return True
+    from .gplog import get_logger
+
+    return get_logger("trace").isEnabledFor(logging.DEBUG)
+
+
+class RequestTracer:
+    """Bounded per-node ring of per-request event timelines."""
+
+    DEFAULT_CAPACITY = 1024
+    NAME_KEYS = 8  # per-name recent-key window for dump_name
+    # per-KEY timeline cap: epoch keys live for a name's whole lifetime,
+    # so a wedged epoch's retransmit rounds would otherwise grow one
+    # key's list without bound (the key-count FIFO never fires for a
+    # reconfigurator, which only ever traces one key per name).  The
+    # first event stays as the t0 anchor; the oldest tail entries drop.
+    EVENTS_PER_KEY = 512
+
+    def __init__(self, node, capacity: Optional[int] = None,
+                 enabled: Optional[bool] = None):
+        self.node = int(node)
+        self.capacity = (
+            self.DEFAULT_CAPACITY if capacity is None else max(1, int(capacity))
+        )
+        self.enabled = trace_enabled() if enabled is None else bool(enabled)
+        self._lock = threading.Lock()
+        # key -> [(t, event, detail dict)]; FIFO-evicted at capacity
+        self._events: "OrderedDict[object, List[Tuple]]" = OrderedDict()
+        # name -> deque of recently traced keys (for name-keyed dumps)
+        self._by_name: Dict[str, deque] = {}
+
+    # ---- recording (hot path when enabled, no-op when not) -----------
+    def note(self, key, event: str, name: Optional[str] = None,
+             **detail) -> None:
+        """Append one event to ``key``'s timeline.  ``name`` additionally
+        indexes the key under that service name for dump_name()."""
+        if not self.enabled:
+            return
+        t = time.monotonic()
+        with self._lock:
+            timeline = self._events.get(key)
+            if timeline is None:
+                while len(self._events) >= self.capacity:
+                    self._events.popitem(last=False)  # FIFO eviction
+                timeline = self._events[key] = []
+            if len(timeline) >= self.EVENTS_PER_KEY:
+                del timeline[1]  # keep event 0: it anchors dump()'s t0
+            timeline.append((t, event, detail))
+            if name is not None:
+                dq = self._by_name.get(name)
+                if dq is None:
+                    # bound the name index like the ring (names are
+                    # few in practice; this is a leak guard, not a
+                    # working-set tune)
+                    while len(self._by_name) >= self.capacity:
+                        self._by_name.pop(next(iter(self._by_name)))
+                    dq = self._by_name[name] = deque(maxlen=self.NAME_KEYS)
+                if not dq or dq[-1] != key:
+                    dq.append(key)
+
+    # ---- inspection ---------------------------------------------------
+    def __len__(self) -> int:
+        return len(self._events)
+
+    def __contains__(self, key) -> bool:
+        return key in self._events
+
+    def events(self, key) -> List[Tuple]:
+        with self._lock:
+            return list(self._events.get(key, ()))
+
+    def keys_for_name(self, name: str) -> List:
+        with self._lock:
+            return list(self._by_name.get(name, ()))
+
+    def dump(self, key) -> str:
+        """One request's timeline, timestamps relative to its first event
+        (the reference's ``getLog()`` dump shape)."""
+        evs = self.events(key)
+        if not evs:
+            return f"<no trace for {key!r} at node {self.node}>"
+        t0 = evs[0][0]
+        lines = [f"request {key!r} @ node {self.node}:"]
+        for t, event, detail in evs:
+            tail = " ".join(f"{k}={v}" for k, v in detail.items())
+            lines.append(
+                f"  +{(t - t0) * 1e3:9.3f}ms {event}"
+                + (f" [{tail}]" if tail else "")
+            )
+        return "\n".join(lines)
+
+    def dump_name(self, name: str, limit: int = 4) -> str:
+        """Timelines of the most recent ``limit`` distinct keys traced
+        under ``name`` — the chaos-soak failure-message payload.  (The
+        per-name key window only suppresses CONSECUTIVE repeats, so
+        interleaved keys must dedup here or one request prints twice.)"""
+        seen = []
+        for k in self.keys_for_name(name):
+            if k in seen:
+                seen.remove(k)  # keep the LAST occurrence's position
+            seen.append(k)
+        keys = seen[-limit:]
+        if not keys:
+            return f"<no traces for name {name!r} at node {self.node}>"
+        return "\n".join(self.dump(k) for k in keys)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self._by_name.clear()

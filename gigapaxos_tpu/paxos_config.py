@@ -58,6 +58,12 @@ class PC(FlagEnum):
     TICK_INTERVAL_S = 0.01               # server drive-loop cadence
     RESPONSE_CACHE_TTL_S = 60.0          # exactly-once retransmit cache TTL
 
+    # ---- observability (obs/: gplog + reqtrace + metrics) -------------
+    # cadence of the server's INFO stats line (engine counters +
+    # DelayProfiler); the line only renders when gp.server is at INFO
+    # (GP_LOG=server:INFO), so the default deployment pays a level check
+    STATS_LOG_PERIOD_S = 10.0
+
     # ---- pause / residency (ref: PaxosConfig.java:277,291) ------------
     PAUSE_OPTION = True
     DEACTIVATION_PERIOD_S = 60.0

@@ -116,6 +116,7 @@ class ActiveReplicaServer(PaxosServer):
                     name, value, callback=cb
                 ),
                 overloaded=self.manager.overloaded,
+                metrics=self.manager.metrics.render,
             )
         except OSError:
             pass  # HTTP port taken: binary protocol still fully serves

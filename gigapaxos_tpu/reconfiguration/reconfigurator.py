@@ -26,6 +26,8 @@ import zlib
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..manager import PaxosManager
+from ..obs import gplog
+from ..obs.reqtrace import RequestTracer
 from ..protocoltask import ProtocolExecutor, ProtocolTask, ThresholdProtocolTask
 from ..utils.config import Config
 from .active_replica import stop_request_id
@@ -84,6 +86,16 @@ class StartEpochTask(ProtocolTask):
         )
 
     def start(self):
+        tr = self.rcf.tracer
+        if tr.enabled:
+            tr.note(
+                f"epoch:{self.op['name']}", "start-epoch-round",
+                name=self.op["name"], node=self.rcf.my_id,
+                epoch=self.op["epoch"], row=self.row,
+                attempt=self.attempt, pending=sorted(
+                    set(self.op["actives"]) - self.acked
+                ),
+            )
         out = []
         for a in self.op["actives"]:
             if a not in self.acked:
@@ -435,6 +447,12 @@ class Reconfigurator:
         self.rc_manager = rc_manager
         self.rc_app = rc_app
         self.send = send
+        self.log = gplog.node_logger("rc", my_id)
+        # epoch-plane tracing (same DEBUG gate as the data plane): epoch
+        # ops for a name trace under the key "epoch:<name>", so a soak
+        # divergence can dump the name's reconfiguration timeline next to
+        # its request timelines
+        self.tracer = RequestTracer(my_id)
         # rows are probed in the APP engine's row space; default to the RC
         # engine's only for legacy in-process setups that share the shape
         self.n_groups = (
@@ -535,6 +553,13 @@ class Reconfigurator:
     def propose_op(self, op: Dict) -> None:
         """Commit an RC-record mutation through the RC paxos group
         (CommitWorker semantics: the protocol task retransmits around it)."""
+        if self.tracer.enabled and op.get("name"):
+            self.tracer.note(
+                f"epoch:{op['name']}", f"rc-propose:{op.get('op')}",
+                name=op["name"], node=self.my_id,
+                epoch=op.get("epoch"), actives=op.get("actives"),
+                new_actives=op.get("new_actives"),
+            )
         self.rc_manager.propose(RC_GROUP, json.dumps(op))
 
     # ------------------------------------------------------------------
@@ -611,6 +636,11 @@ class Reconfigurator:
     def note_unfinished_drop(
         self, name: str, epoch: int, stragglers: List[int]
     ) -> None:
+        if self.tracer.enabled:
+            self.tracer.note(
+                f"epoch:{name}", "drop-unfinished", name=name,
+                node=self.my_id, epoch=epoch, stragglers=list(stragglers),
+            )
         prev = self._unfinished_drops.get((name, epoch))
         # preserve the previous attempt timestamp: resetting it to 0.0
         # made the post-budget slow cadence (`_redrive_unfinished_drops`'s
@@ -1474,6 +1504,12 @@ class Reconfigurator:
     def _on_applied(self, op: Dict) -> None:
         """Fires on EVERY reconfigurator when an RC-record op executes;
         only the record's primary drives the next protocol step."""
+        if self.tracer.enabled and op.get("name"):
+            self.tracer.note(
+                f"epoch:{op['name']}", f"rc-applied:{op.get('op')}",
+                name=str(op["name"]), node=self.my_id,
+                applied=bool(op.get("applied")), epoch=op.get("epoch"),
+            )
         if op["op"] in (AR_ADD, AR_REMOVE):
             # membership ops affect every RC: refresh the ring, answer the
             # client wherever it registered; affected names migrate off a

@@ -17,6 +17,7 @@ transport).  Each server runs:
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Callable, Dict, Optional, Tuple
@@ -34,6 +35,7 @@ from .net.codec import (
 )
 from .net.node_config import NodeConfig
 from .net.transport import MessageTransport
+from .obs import gplog
 from .ops.engine import EngineConfig
 from .paxos_config import PC
 from .utils.config import Config
@@ -54,7 +56,11 @@ class PaxosServer:
         self.my_id = int(my_id)
         self.node_config = node_config
         self.cfg = cfg
+        self.log = gplog.node_logger("server", my_id)
         self.manager = PaxosManager(my_id, app, cfg, log_dir=log_dir)
+        # the node's tracer lives on the manager (propose/decide/execute
+        # record there); the server notes ingress/egress on the same ring
+        self.tracer = self.manager.tracer
         # TLS per the configured SSL_MODE (CLEAR/SERVER_AUTH/MUTUAL_AUTH,
         # SSLDataProcessingWorker.java:59 analog)
         from .net.ssl_util import (
@@ -144,6 +150,11 @@ class PaxosServer:
         self.CHUNK_PACE_S = 0.002  # per-chunk stagger: lets other frames in
         self._xfer_seq = 0
         self._schema_skew_warned: set = set()
+        # periodic INFO stats line (the reference's DelayProfiler dump
+        # cadence): emitted only when gp.server is at INFO, so a default
+        # deployment stays silent and pays one level check per period
+        self._stats_period_s = Config.get_float(PC.STATS_LOG_PERIOD_S)
+        self._last_stats_line = time.monotonic()
         self._chunk_lock = threading.Lock()
         # (sender, xfer id) -> {"n": total, "parts": {i: bytes}, "t": time}
         self._chunk_rx: Dict[Tuple[int, str], Dict] = {}
@@ -204,13 +215,10 @@ class PaxosServer:
             # must not be swallowed silently as a JSON decode error)
             if kind not in self._schema_skew_warned:
                 self._schema_skew_warned.add(kind)
-                import sys
-
-                print(
-                    f"paxos-server-{self.my_id}: dropping frame of "
-                    f"unrecognized schema {kind!r} (this node speaks "
-                    "'D'/'J'; a mixed-version peer must be upgraded)",
-                    file=sys.stderr, flush=True,
+                self.log.warning(
+                    "dropping frame of unrecognized schema %r (this node "
+                    "speaks 'D'/'J'; a mixed-version peer must be upgraded)",
+                    kind,
                 )
             return
         if kind == "D":
@@ -343,7 +351,15 @@ class PaxosServer:
                 return
             bufs, self._resp_buf = self._resp_buf, {}
         t0 = time.monotonic()
+        tr = self.tracer
         for reply, items in bufs.values():
+            if tr.enabled:
+                for item in items:
+                    tr.note(
+                        item.get("request_id"), "respond-flush",
+                        name=item.get("name"), node=self.my_id,
+                        error=item.get("error"),
+                    )
             if len(items) == 1:
                 reply(encode_json("client_response", self.my_id, items[0]))
             else:
@@ -380,6 +396,7 @@ class PaxosServer:
         frame)."""
         t0 = time.monotonic()
         m = self.manager
+        tr = self.tracer
         overloaded = m.overloaded()
         items = []
         for sub in reqs:
@@ -388,6 +405,9 @@ class PaxosServer:
                 continue
             request_id = int(sub["request_id"])
             name = sub["name"]
+            if tr.enabled:
+                tr.note(request_id, "recv", name=name, node=self.my_id,
+                        batch=True)
 
             def cb(rid, response, _name=name):
                 self._buffer_response(reply, {
@@ -427,6 +447,9 @@ class PaxosServer:
     def _on_client_request_inner(self, body: Dict, reply) -> None:
         request_id = int(body["request_id"])
         name = body["name"]
+        if self.tracer.enabled:
+            self.tracer.note(request_id, "recv", name=name, node=self.my_id,
+                             stop=bool(body.get("stop", False)))
         if not body.get("stop") and self._maybe_local_read(
             name, body.get("value", ""), request_id,
             lambda rid, response: self._buffer_response(reply, {
@@ -494,6 +517,24 @@ class PaxosServer:
             reply(encode_json("admin_response", self.my_id, {
                 "op": op, "name": body["name"], "ok": bool(ok),
             }))
+        elif op == "stats":
+            # engine counters + DelayProfiler snapshot over the admin
+            # plane — the deployed analog of the AR HTTP /stats page,
+            # reachable wherever the binary protocol is
+            reply(encode_json("admin_response", self.my_id, {
+                "op": op, "name": body.get("name"), "ok": True,
+                "tick": self._tick,
+                "engine": self.manager.metrics.snapshot(),
+                "profiler": DelayProfiler.get_snapshot(),
+                "profiler_line": DelayProfiler.get_stats(),
+            }))
+        else:
+            # an unknown op must still ANSWER: silence leaves the
+            # client's admin waiter parked until its timeout
+            reply(encode_json("admin_response", self.my_id, {
+                "op": op, "name": body.get("name"), "ok": False,
+                "error": "unknown_op",
+            }))
 
     # ---- the tick loop -------------------------------------------------
     def _run(self) -> None:
@@ -505,10 +546,9 @@ class PaxosServer:
                     self._last_full_tick = time.monotonic()
                 else:
                     self.idle_once()
+                self._maybe_stats_line()
             except Exception:
-                import traceback
-
-                traceback.print_exc()
+                self.log.exception("tick loop error (loop continues)")
             dt = time.perf_counter() - t0
             interval = self.tick_interval
             if self._batching and self.manager.has_backlog():
@@ -623,6 +663,10 @@ class PaxosServer:
         ):
             self._last_publish = time.monotonic()
             blob_frame = encode_blob_vec(self.my_id, self._tick, blob_vec)
+            mx = m.metrics
+            mx.gauge("blob_frame_bytes", len(blob_frame))
+            mx.count("blob_bytes_sent", len(blob_frame) * len(peers))
+            mx.count("blob_frames_sent", len(peers))
             for r in peers:
                 self.transport.send_to_id(r, blob_frame)
         if delta["arena"] or delta.get("app_exec"):
@@ -648,6 +692,20 @@ class PaxosServer:
         self._layer_tick()
         DelayProfiler.update_count("t_layer", time.monotonic() - t_layer)
         self._flush_responses()  # callbacks fired by this tick's execution
+
+    def _maybe_stats_line(self) -> None:
+        """Periodic INFO stats line (engine counters + DelayProfiler) —
+        one `isEnabledFor` check per period when INFO is off."""
+        now = time.monotonic()
+        if now - self._last_stats_line < self._stats_period_s:
+            return
+        self._last_stats_line = now
+        if self.log.isEnabledFor(logging.INFO):
+            self.log.info(
+                "stats tick=%d %s %s", self._tick,
+                self.manager.metrics.summary_line(),
+                DelayProfiler.get_stats(),
+            )
 
     def _maybe_ping(self) -> None:
         """Failure-detection pings at period = timeout/2

@@ -257,9 +257,12 @@ class PaxosLogger:
             try:
                 self._checkpoint_write(*item)
             except Exception:
-                import traceback
+                from ..obs import gplog
 
-                traceback.print_exc()  # next cadence point retries
+                # next cadence point retries; the failure must be visible
+                gplog.node_logger("storage", self.node_id).exception(
+                    "async checkpoint write failed (next cadence retries)"
+                )
 
     def drain_checkpoints(self, timeout: float = 30.0) -> None:
         """Block until any pending async snapshot is on disk (close/final

@@ -43,8 +43,11 @@ class SoakDivergence(AssertionError):
 
 
 def _name_diag(c: ReconfigurableCluster, nm: str, actives: List[int]) -> Dict:
-    """Per-member engine + dedup evidence for one name."""
-    out = {}
+    """Per-member engine + dedup evidence for one name, plus (when the
+    per-request tracer is on — run_soak enables it) each member's recent
+    request timelines for the name and the RCs' epoch-op timeline, so a
+    divergence message carries the requests' actual journeys."""
+    out: Dict = {}
     for a in actives:
         m = c.ars.managers[a]
         row = m.names.get(nm)
@@ -71,7 +74,16 @@ def _name_diag(c: ReconfigurableCluster, nm: str, actives: List[int]) -> Dict:
         ent["old_epochs"] = sorted(
             e for (n, e) in m.old_epochs if n == nm
         )
+        if m.tracer.enabled:
+            ent["trace"] = m.tracer.dump_name(nm)
         out[a] = ent
+    rc_traces = {
+        rc.my_id: rc.tracer.dump(f"epoch:{nm}")
+        for rc in c.reconfigurators
+        if rc.tracer.enabled and f"epoch:{nm}" in rc.tracer
+    }
+    if rc_traces:
+        out["rc_epoch_trace"] = rc_traces
     return out
 
 
@@ -160,6 +172,14 @@ def run_soak(
         )
         n_ar = ar_cfg.n_replicas
         c = ReconfigurableCluster(ar_cfg, rc_cfg, HashChainApp)
+        # soaks always trace: the whole point of a soak failure is the
+        # forensics, and the stepped cluster has no hot-path budget to
+        # protect — a SoakDivergence then carries each member's recent
+        # request timelines for the offending name (_name_diag)
+        for m in c.ars.managers:
+            m.tracer.enabled = True
+        for rc_l in c.reconfigurators:
+            rc_l.tracer.enabled = True
         for rc in c.reconfigurators:
             rc.REDRIVE_EVERY = 4
             # compress the slow READY-audit cadence to the soak's
@@ -252,9 +272,30 @@ def run_soak(
                 for r in recs.values()
             )
         if not settled:
+            # the WAIT_* liveness-wedge family lands HERE, so this message
+            # must carry the forensics: for each unsettled name, the full
+            # per-member diag including request timelines and the RCs'
+            # epoch-op timeline (which round is stalled, who never acked)
+            stuck = {
+                nm: r for nm, r in recs.items()
+                if r is not None and not r.deleted
+                and r.state not in (RCState.READY, RCState.PAUSED)
+            }
             raise SoakDivergence(
                 "records did not settle",
-                {nm: (r.to_json() if r else None) for nm, r in recs.items()},
+                {
+                    "records": {
+                        nm: (r.to_json() if r else None)
+                        for nm, r in recs.items()
+                    },
+                    "unsettled": {
+                        nm: _name_diag(
+                            c, nm,
+                            sorted(set(r.actives) | set(r.new_actives or []))
+                        )
+                        for nm, r in stuck.items()
+                    },
+                },
             )
 
         # record agreement across RCs
@@ -322,7 +363,10 @@ def run_soak(
                     "READY actives not aligned at record row",
                     {"name": nm, "want_row": rec.row, "rows": sorted(
                         (a, c.ars.managers[a].names.get(nm))
-                        for a in rec.actives)},
+                        for a in rec.actives),
+                     # which start/commit round stranded the outlier —
+                     # the 20260803 re-probe hit this shape blind
+                     "members": _name_diag(c, nm, list(rec.actives))},
                 )
             # RSM convergence: poll app state AND the engine triple (a
             # laggard may need many blocked-pull rounds); then audit

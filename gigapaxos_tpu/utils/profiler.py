@@ -68,6 +68,18 @@ class DelayProfiler:
         return "[" + " ".join(parts) + "]"
 
     @classmethod
+    def get_snapshot(cls) -> Dict[str, Dict[str, float]]:
+        """Structured (JSON-safe) form of :meth:`get_stats` — the ``stats``
+        admin op and the metrics endpoints ship this instead of making
+        machine consumers parse the human one-liner."""
+        with cls._lock:
+            return {
+                "avgs": dict(cls._avgs),
+                "counts": dict(cls._counts),
+                "rates": {k: v for k, (v, _) in cls._rates.items()},
+            }
+
+    @classmethod
     def clear(cls) -> None:
         with cls._lock:
             cls._avgs.clear()

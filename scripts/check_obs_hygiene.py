@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Observability hygiene gate: no ad-hoc stdout/stderr in the package.
+
+AST-based static pass over ``gigapaxos_tpu/`` forbidding the two escape
+hatches the logging plane replaced:
+
+* bare ``print(...)`` calls;
+* ``<anything>.stderr.write(...)`` / ``<anything>.stdout.write(...)``
+  (catches ``sys.stderr.write`` and aliased imports like ``_sys``).
+
+``gigapaxos_tpu/obs/`` is exempt — it is the one place allowed to own a
+stream handler.  Run standalone (exit 1 on violations) or through the
+tier-1 test ``tests/test_obs.py::test_obs_hygiene_gate`` so future code
+stays on the logging plane.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import sys
+from typing import Iterator, Tuple
+
+PACKAGE = "gigapaxos_tpu"
+EXEMPT_TOP_DIRS = ("obs",)
+
+
+def _stream_write(func: ast.AST) -> bool:
+    """True for ``<expr>.{stderr,stdout}.write``."""
+    return (
+        isinstance(func, ast.Attribute)
+        and func.attr == "write"
+        and isinstance(func.value, ast.Attribute)
+        and func.value.attr in ("stderr", "stdout")
+    )
+
+
+def iter_violations(pkg_root: pathlib.Path) -> Iterator[Tuple[str, int, str]]:
+    """Yield (relative path, line, description) per violation."""
+    for path in sorted(pkg_root.rglob("*.py")):
+        rel = path.relative_to(pkg_root)
+        if rel.parts[0] in EXEMPT_TOP_DIRS:
+            continue
+        tree = ast.parse(path.read_text(encoding="utf-8"), filename=str(path))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Name) and func.id == "print":
+                yield (str(rel), node.lineno,
+                       "bare print() — use gigapaxos_tpu.obs.gplog")
+            elif _stream_write(func):
+                yield (str(rel), node.lineno,
+                       f"direct {func.value.attr}.write() — "
+                       "use gigapaxos_tpu.obs.gplog")
+
+
+def main(argv=None) -> int:
+    root = pathlib.Path(
+        (argv or sys.argv[1:] or [None])[0]
+        or pathlib.Path(__file__).resolve().parent.parent / PACKAGE
+    )
+    bad = list(iter_violations(root))
+    for rel, line, why in bad:
+        print(f"{PACKAGE}/{rel}:{line}: {why}")
+    if bad:
+        print(f"{len(bad)} obs-hygiene violation(s)")
+        return 1
+    print("obs hygiene clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

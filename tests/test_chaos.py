@@ -108,6 +108,30 @@ def test_chaos_duplicate_retransmits():
         ran += 1
 
 
+def test_chaos_traced_liveness_seeds():
+    """Re-probe the r5 sweep's recorded WAIT_ACK_STOP/START liveness
+    seeds at their heavy shape, now with per-request tracing wired into
+    the soak (run_soak enables every member's RequestTracer): a hit's
+    DISCOVERY warning carries the offending name's request timelines and
+    the RCs' epoch-op timeline (``_name_diag``'s ``trace`` /
+    ``rc_epoch_trace`` fields), so a wedge arrives root-causable instead
+    of just red.  DISCOVERY convention, not a gate — the family is
+    contention-dependent: the 2026-08-03 re-probe settled all four clean
+    on an idle box, but the SAME probe under deliberate load hit two
+    shapes whose embedded traces root-caused them (seeds 662625602 /
+    661277166 — see README fault-model notes)."""
+    budget = float(os.environ.get("CHAOS_TRACED_BUDGET_S", "40"))
+    deadline = time.time() + budget
+    for seed in (661118786, 661277166, 555688974, 662625602):
+        if not _fresh(
+            seed, f"run_soak({seed}, rounds=90, loss=0.3)",
+            rounds=90, loss=0.3,
+        ):
+            break
+        if time.time() > deadline:
+            break
+
+
 def test_chaos_large_shape():
     """One soak at a bigger deployment shape: more groups, wider window,
     5 replicas, more adversarial rounds."""

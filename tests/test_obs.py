@@ -1,0 +1,311 @@
+"""Observability plane tests: tracer ring semantics, DEBUG gating, lazy
+logging, GP_LOG grammar, the metrics registry, the ``stats`` admin op
+over a live loopback cluster, the unknown-admin-op reply, the chaos-diag
+trace ride-along, and the obs-hygiene static gate."""
+
+import io
+import logging
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+from gigapaxos_tpu.obs import gplog
+from gigapaxos_tpu.obs.metrics import Histogram, MetricsRegistry
+from gigapaxos_tpu.obs.reqtrace import RequestTracer
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+# ---- tracer ----------------------------------------------------------
+def test_tracer_ring_bound_and_fifo_eviction():
+    t = RequestTracer(0, capacity=4, enabled=True)
+    for rid in range(10):
+        t.note(rid, "recv", name="svc", node=0)
+        t.note(rid, "execute", slot=rid)
+    assert len(t) == 4
+    # FIFO: only the newest 4 keys survive
+    assert all(rid in t for rid in range(6, 10))
+    assert all(rid not in t for rid in range(6))
+    # a new event on a surviving key appends, not re-inserts
+    t.note(7, "respond-flush")
+    assert [e[1] for e in t.events(7)] == ["recv", "execute", "respond-flush"]
+
+
+def test_tracer_disabled_records_nothing():
+    t = RequestTracer(1, capacity=16, enabled=False)
+    t.note(42, "recv", name="svc", node=1)
+    t.note(42, "execute", slot=3)
+    assert len(t) == 0
+    assert t.events(42) == []
+    assert "no trace" in t.dump(42)
+    assert "no traces" in t.dump_name("svc")
+
+
+def test_tracer_dump_timeline_and_name_index():
+    t = RequestTracer(2, enabled=True)
+    t.note(7, "recv", name="a", node=2)
+    t.note(7, "propose", name="a", vid=99, row=3)
+    t.note(8, "recv", name="a", node=2)
+    t.note(9, "recv", name="b", node=2)
+    d = t.dump(7)
+    assert "request 7 @ node 2" in d
+    assert "recv" in d and "propose" in d and "vid=99" in d
+    assert "ms" in d  # relative-timestamp lines
+    assert t.keys_for_name("a") == [7, 8]
+    nd = t.dump_name("a")
+    assert "request 7" in nd and "request 8" in nd and "request 9" not in nd
+
+
+def test_tracer_default_gate_follows_gp_log(monkeypatch):
+    gplog.reset_for_tests()
+    try:
+        monkeypatch.delenv("GP_TRACE", raising=False)
+        monkeypatch.setenv("GP_LOG", "")
+        assert RequestTracer(0).enabled is False
+        monkeypatch.setenv("GP_LOG", "trace:DEBUG")
+        gplog.configure(stream=io.StringIO(), force=True)
+        assert RequestTracer(0).enabled is True
+        monkeypatch.setenv("GP_LOG", "")
+        monkeypatch.setenv("GP_TRACE", "1")
+        gplog.reset_for_tests()
+        assert RequestTracer(0).enabled is True
+    finally:
+        gplog.reset_for_tests()
+
+
+# ---- logging ---------------------------------------------------------
+class _Sentinel:
+    """__str__ counter: proves %-args only format past the level check."""
+
+    def __init__(self):
+        self.n = 0
+
+    def __str__(self):
+        self.n += 1
+        return "S"
+
+
+def test_gplog_lazy_formatting_below_level():
+    gplog.reset_for_tests()
+    try:
+        sink = io.StringIO()
+        gplog.configure(stream=sink, force=True)  # default WARNING
+        log = gplog.node_logger("lazytest", 7)
+        s = _Sentinel()
+        log.debug("value=%s", s)
+        log.info("value=%s", s)
+        assert s.n == 0, "args formatted below the enabled level"
+        log.warning("value=%s", s)
+        assert s.n == 1
+        out = sink.getvalue()
+        assert "[node 7]" in out and "value=S" in out
+        assert "gp.lazytest" in out
+    finally:
+        gplog.reset_for_tests()
+
+
+def test_gplog_env_grammar():
+    gplog.reset_for_tests()
+    try:
+        gplog.configure(stream=io.StringIO(), force=True)
+        gplog.apply_env_levels("INFO,server:DEBUG, rc:ERROR")
+        assert logging.getLogger("gp").level == logging.INFO
+        assert logging.getLogger("gp.server").level == logging.DEBUG
+        assert logging.getLogger("gp.rc").level == logging.ERROR
+        # unparseable fragments are skipped, never raise
+        gplog.apply_env_levels("server:NOTALEVEL,garbage")
+        assert logging.getLogger("gp.server").level == logging.DEBUG
+    finally:
+        gplog.reset_for_tests()
+
+
+def test_warn_once_dedup():
+    gplog.reset_for_tests()
+    try:
+        sink = io.StringIO()
+        gplog.configure(stream=sink, force=True)
+        log = gplog.node_logger("oncetest", 3)
+        for _ in range(5):
+            gplog.warn_once(log, "kindX", "dropping frame of kind %s", "X")
+        gplog.warn_once(log, "kindY", "dropping frame of kind %s", "Y")
+        out = sink.getvalue()
+        assert out.count("kind X") == 1
+        assert out.count("kind Y") == 1
+    finally:
+        gplog.reset_for_tests()
+
+
+# ---- metrics ---------------------------------------------------------
+def test_histogram_buckets_and_stats():
+    h = Histogram(bounds=(1.0, 10.0, 100.0))
+    for x in (0.5, 5, 5, 50, 500):
+        h.observe(x)
+    snap = h.snapshot()
+    assert snap["count"] == 5
+    assert snap["min"] == 0.5 and snap["max"] == 500
+    assert snap["buckets"] == [
+        [1.0, 1], [10.0, 2], [100.0, 1], ["+inf", 1]
+    ]
+
+
+def test_histogram_always_ships_inf_bucket():
+    # no overflow observed: the terminal bucket must still render (with
+    # the Prometheus "+Inf" spelling) or histogram_quantile returns NaN
+    m = MetricsRegistry(node=1)
+    m.observe("lat_s", 0.5, bounds=(1.0, 10.0))
+    snap = m.snapshot()["hists"]["lat_s"]
+    assert snap["buckets"] == [[1.0, 1], [10.0, 0], ["+inf", 0]]
+    text = m.render()
+    assert 'le="+Inf"} 1' in text
+
+
+def test_render_counters_full_precision():
+    # %g's 6 significant digits would quantize large counters and break
+    # rate() over successive scrapes
+    m = MetricsRegistry(node=1)
+    m.count("decisions_executed", 10_000_000_019)
+    assert 'gp_decisions_executed_total{node="1"} 10000000019' in m.render()
+
+
+def test_tracer_per_key_event_cap_keeps_anchor():
+    t = RequestTracer(0, enabled=True)
+    t.note("epoch:n0", "rc-propose:create_intent", name="n0")
+    for i in range(2 * RequestTracer.EVENTS_PER_KEY):
+        t.note("epoch:n0", "start-epoch-round", attempt=i)
+    evs = t.events("epoch:n0")
+    assert len(evs) == RequestTracer.EVENTS_PER_KEY
+    assert evs[0][1] == "rc-propose:create_intent"  # t0 anchor survives
+    assert evs[-1][2]["attempt"] == 2 * RequestTracer.EVENTS_PER_KEY - 1
+
+
+def test_metrics_registry_roundtrip():
+    m = MetricsRegistry(node=5)
+    m.count("decisions_executed", 3)
+    m.count("decisions_executed", 4)
+    m.gauge("frontier_stall_groups", 2)
+    m.observe("engine_step_s", 0.002)
+    assert m.get("decisions_executed") == 7
+    assert m.get("frontier_stall_groups") == 2
+    snap = m.snapshot()
+    assert snap["node"] == 5
+    assert snap["counters"]["decisions_executed"] == 7
+    assert snap["hists"]["engine_step_s"]["count"] == 1
+    text = m.render()
+    assert 'gp_decisions_executed_total{node="5"} 7' in text
+    assert "gp_engine_step_s_bucket" in text
+    line = m.summary_line()
+    assert "decisions_executed:7" in line
+
+
+# ---- the stats admin op over a live loopback cluster -----------------
+def test_stats_admin_roundtrip_and_unknown_op():
+    from gigapaxos_tpu.clients import PaxosClientAsync
+    from gigapaxos_tpu.models import StatefulAdderApp
+    from gigapaxos_tpu.net.node_config import NodeConfig
+    from gigapaxos_tpu.ops.engine import EngineConfig
+    from gigapaxos_tpu.server import PaxosServer
+    from gigapaxos_tpu.testing.ports import free_ports
+
+    cfg = EngineConfig(n_groups=6, window=8, req_lanes=4, n_replicas=2)
+    ports = free_ports(2)
+    nc = NodeConfig({i: ("127.0.0.1", p) for i, p in enumerate(ports)})
+    servers = [
+        PaxosServer(i, nc, StatefulAdderApp(), cfg, tick_interval=0.01)
+        for i in range(2)
+    ]
+    for s in servers:
+        s.start()
+    client = PaxosClientAsync([("127.0.0.1", p) for p in ports])
+    try:
+        # unknown op answers instead of hanging the waiter to timeout
+        r = client.admin_sync(0, {"op": "frobnicate", "name": "x"},
+                              timeout=10)
+        assert r is not None, "unknown admin op never answered"
+        assert r["ok"] is False and r["error"] == "unknown_op"
+
+        assert client.create_paxos_instance("obs", [0, 1], timeout=30)
+        assert client.send_request_sync("obs", "5", timeout=30) == "5"
+        # the response fires at the ENTRY replica (possibly node 1), and
+        # node 0's engine can run a tick behind it — poll until node 0's
+        # own counter reflects the committed decision
+        deadline = time.time() + 30
+        while True:
+            r = client.admin_sync(0, {"op": "stats"}, timeout=10)
+            assert r is not None and r["ok"] is True
+            eng = r["engine"]
+            if eng["counters"].get("decisions_executed", 0) >= 1:
+                break
+            assert time.time() < deadline, eng["counters"]
+            time.sleep(0.2)
+        assert "engine_step_s" in eng["hists"]
+        # blob publishing happened, so the wire-cost counters are live
+        assert eng["counters"].get("blob_bytes_sent", 0) > 0
+        assert "profiler" in r and "counts" in r["profiler"]
+        assert r["profiler_line"].startswith("[")
+    finally:
+        client.close()
+        for s in servers:
+            s.stop()
+
+
+# ---- chaos-diag trace ride-along -------------------------------------
+def test_name_diag_carries_request_trace():
+    """The soak failure payload: with tracing on (as run_soak enables
+    it), _name_diag's per-member entries carry the offending name's
+    request timelines, so a SoakDivergence message shows each request's
+    journey (the RequestInstrumenter debugging loop, end to end)."""
+    from gigapaxos_tpu.models.apps import HashChainApp
+    from gigapaxos_tpu.ops.engine import EngineConfig
+    from gigapaxos_tpu.testing.chaos import SoakDivergence, _name_diag
+    from gigapaxos_tpu.testing.rc_cluster import ReconfigurableCluster
+
+    ar_cfg = EngineConfig(n_groups=8, window=8, req_lanes=4, n_replicas=3)
+    rc_cfg = EngineConfig(n_groups=4, window=8, req_lanes=4, n_replicas=3)
+    c = ReconfigurableCluster(ar_cfg, rc_cfg, HashChainApp)
+    try:
+        for m in c.ars.managers:
+            m.tracer.enabled = True
+        for rc in c.reconfigurators:
+            rc.tracer.enabled = True
+        c.client_request(
+            "create_service", {"name": "tn", "actives": [0, 1, 2]}
+        )
+        for _ in range(40):
+            c.step()
+        rid = (1 << 55) + 12345
+        c.ars.managers[0].propose("tn", "v0", request_id=rid)
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            c.step()
+            if all(m.app.state.get("tn") for m in c.ars.managers):
+                break
+        assert c.ars.managers[0].app.state.get("tn"), "request never executed"
+        diag = _name_diag(c, "tn", [0, 1, 2])
+        # every member's entry shows the request's timeline
+        for a in (0, 1, 2):
+            tr = diag[a].get("trace", "")
+            assert f"request {rid}" in tr, (a, tr)
+            assert "propose" in tr or "execute" in tr
+        # the RC epoch timeline rides along too
+        assert "rc_epoch_trace" in diag
+        assert any("rc-applied" in v or "rc-propose" in v
+                   for v in diag["rc_epoch_trace"].values())
+        # and the failure message a soak would raise CONTAINS the timeline
+        msg = str(SoakDivergence("synthetic", {"members": diag}))
+        assert f"request {rid}" in msg and "+" in msg
+        # engine metrics moved during the run
+        assert c.ars.managers[0].metrics.get("decisions_executed") >= 1
+    finally:
+        c.close()
+
+
+# ---- hygiene gate ----------------------------------------------------
+def test_obs_hygiene_gate():
+    """No bare print()/std-stream writes outside obs/ — runs the same
+    AST pass future CI uses, as a tier-1 test."""
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "check_obs_hygiene.py")],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
