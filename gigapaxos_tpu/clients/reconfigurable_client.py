@@ -45,6 +45,12 @@ class ReconfigurableAppClient(AsyncFrameClient):
         self.redirector = LatencyAwareRedirector()
         # name -> (expiry, [active ids]) — the TTL'd request->actives table
         self._actives_cache: Dict[str, Tuple[float, List[int]]] = {}
+        # echo-probe round in flight: actives awaited + completion event;
+        # replies carry the round number back so a LATE reply from an
+        # earlier round cannot complete (or undercount) the current one
+        self._probe_pending: set = set()
+        self._probe_round = 0
+        self._probe_done = threading.Event()
         # app-request callbacks:
         # request_id -> (send_time, cb(rid, resp, error), target, n_sends)
         self._callbacks: Dict[int, Tuple[float, Callable, Optional[int], int]] = {}
@@ -71,6 +77,52 @@ class ReconfigurableAppClient(AsyncFrameClient):
              for i, n in enumerate(sorted(ar))},
             [(rc[n][0], rc[n][1] + off) for n in sorted(rc)],
         )
+
+    # ------------------------------------------------------------------
+    # latency orientation (EchoRequest analog, Reconfigurator.java:2420)
+    # ------------------------------------------------------------------
+    def probe_actives(self, wait_s: float = 1.0) -> int:
+        """Echo-probe every known active and SEED the redirector's RTT
+        estimates from the replies, so the very first ``send_request``
+        pick is latency-oriented instead of arbitrary (cold start was
+        previously blind until real traffic taught the EWMA).  Blocks up
+        to ``wait_s`` for the round to complete; returns how many actives
+        have an estimate afterwards.  Safe to call repeatedly — seeding
+        never overwrites traffic-learned estimates."""
+        with self._lock:
+            self._probe_pending = set(self.actives)
+            self._probe_round += 1
+            rnd = self._probe_round
+            self._probe_done.clear()
+        for aid, addr in self.actives.items():
+            # ts stamped PER SEND: one shared stamp would fold the
+            # serialization/connect time of every earlier send into the
+            # later actives' RTTs, making the seeded ordering track probe
+            # order instead of network latency
+            self.send_frame(addr, encode_json("echo", self.my_tag, {
+                "ts": time.time(), "round": rnd,
+            }))
+        if wait_s > 0:
+            self._probe_done.wait(wait_s)
+        return sum(
+            1 for aid in self.actives
+            if self.redirector.rtt.get(int(aid)) is not None
+        )
+
+    def _on_echo_reply(self, body: Dict, sender: int) -> None:
+        ts = body.get("ts")
+        if ts is None:
+            return
+        # the RTT is valid whichever round it came from (measured against
+        # its OWN send stamp) — only the round bookkeeping is gated
+        rtt = max(0.0, time.time() - float(ts))
+        self.redirector.seed(int(sender), rtt)
+        with self._lock:
+            if body.get("round") != self._probe_round:
+                return  # a straggler from an earlier probe round
+            self._probe_pending.discard(int(sender))
+            if not self._probe_pending:
+                self._probe_done.set()
 
     # ------------------------------------------------------------------
     # name management (create/delete/reconfigure via any RC)
@@ -424,6 +476,8 @@ class ReconfigurableAppClient(AsyncFrameClient):
         k, sender, body = decode_json(payload)
         if k == "client_response":
             self._on_response(body, sender)
+        elif k == "echo_reply":
+            self._on_echo_reply(body, sender)
         elif k == "client_response_batch":
             for sub in body.get("resps", ()):
                 self._on_response(sub, sender)

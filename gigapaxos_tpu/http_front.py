@@ -16,9 +16,12 @@ Endpoints (reconfigurator):
   POST / {"type": "CREATE",  "name": N, "initialState": S}
   POST / {"type": "DELETE",  "name": N}
   POST / {"type": "RECONFIGURE", "name": N, "actives": [..]}
+  GET  /stats                   -> DelayProfiler + placement snapshot
+  GET  /metrics                 -> RC engine registry (placement gauges)
 Endpoints (active replica):
   POST / {"name": N, "request": value}   -> execute through consensus
   GET  /stats                            -> DelayProfiler snapshot
+  GET  /metrics                          -> engine registry (Prometheus)
 """
 
 from __future__ import annotations
@@ -64,6 +67,33 @@ class _Waiter:
         self.ev.set()
 
 
+# shared response plumbing for BOTH role handlers (AR and RC serve the
+# same /stats-/metrics exposition shapes; one copy, no drift)
+def _send_json(handler: BaseHTTPRequestHandler, code: int, obj: Dict) -> None:
+    _send_bytes(handler, code, json.dumps(obj).encode("utf-8"),
+                "application/json")
+
+
+def _send_text(handler: BaseHTTPRequestHandler, code: int, text: str) -> None:
+    _send_bytes(handler, code, text.encode("utf-8"),
+                "text/plain; charset=utf-8")
+
+
+def _send_bytes(handler, code: int, data: bytes, ctype: str) -> None:
+    handler.send_response(code)
+    handler.send_header("Content-Type", ctype)
+    handler.send_header("Content-Length", str(len(data)))
+    handler.end_headers()
+    handler.wfile.write(data)
+
+
+def _metrics_body(metrics: Optional[Callable[[], str]]) -> str:
+    """The /metrics exposition: the node's registry render with the
+    DelayProfiler line riding along so one scrape sees both planes."""
+    body = metrics() if metrics is not None else ""
+    return body + "# delayprofiler " + DelayProfiler.get_stats() + "\n"
+
+
 def _http_server(host: str, port: int, handler_cls) -> ThreadingHTTPServer:
     srv = ThreadingHTTPServer((host, port), handler_cls)
     srv.daemon_threads = True
@@ -78,40 +108,48 @@ def start_rc_http(
     port: int,
     submit: Callable[[str, Dict, Callable[[str, Dict], None]], None],
     timeout_s: float = 20.0,
+    metrics: Optional[Callable[[], str]] = None,
+    stats: Optional[Callable[[], Dict]] = None,
 ) -> ThreadingHTTPServer:
     """Mount the reconfigurator REST API.  ``submit(kind, body, reply)``
-    injects the op into the RC demux with `reply` as the client sink."""
+    injects the op into the RC demux with `reply` as the client sink.
+    ``metrics()`` renders the RC engine's registry (``GET /metrics``,
+    Prometheus-style — carries the placement gauges/counters);
+    ``stats()`` returns the layer's structured stats (``GET /stats`` —
+    the placement snapshot: per-active loads, probe RTTs)."""
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):  # quiet
             pass
 
-        def _respond(self, code: int, obj: Dict) -> None:
-            data = json.dumps(obj).encode("utf-8")
-            self.send_response(code)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(data)))
-            self.end_headers()
-            self.wfile.write(data)
-
         def _run(self, op_type: str, payload: Dict) -> None:
             if op_type not in _RC_OPS:
-                self._respond(400, {"error": f"unknown type {op_type!r}"})
+                _send_json(self, 400, {"error": f"unknown type {op_type!r}"})
                 return
             if not payload.get("name"):
-                self._respond(400, {"error": "missing name"})
+                _send_json(self, 400, {"error": "missing name"})
                 return
             kind, _ack = _RC_OPS[op_type]
             w = _Waiter()
             submit(kind, _body_of(op_type, payload), w)
             if not w.ev.wait(timeout_s):
-                self._respond(504, {"error": "timeout"})
+                _send_json(self, 504, {"error": "timeout"})
                 return
             body = w.reply["body"]
             code = 200 if body.get("ok") else 409
-            self._respond(code, body)
+            _send_json(self, code, body)
 
         def do_GET(self):
+            path = urlparse(self.path).path
+            if path == "/stats":
+                body = {"stats": DelayProfiler.get_stats()}
+                if stats is not None:
+                    body.update(stats() or {})
+                _send_json(self, 200, body)
+                return
+            if path == "/metrics":
+                _send_text(self, 200, _metrics_body(metrics))
+                return
             q = parse_qs(urlparse(self.path).query)
             name = (q.get("name") or [None])[0]
             op = (q.get("type") or ["REQ_ACTIVES"])[0].upper()
@@ -122,7 +160,7 @@ def start_rc_http(
             try:
                 payload = json.loads(self.rfile.read(length) or b"{}")
             except json.JSONDecodeError:
-                self._respond(400, {"error": "bad json"})
+                _send_json(self, 400, {"error": "bad json"})
                 return
             self._run(str(payload.get("type", "")).upper(), payload)
 
@@ -148,48 +186,29 @@ def start_ar_http(
         def log_message(self, *a):
             pass
 
-        def _respond(self, code: int, obj: Dict) -> None:
-            data = json.dumps(obj).encode("utf-8")
-            self.send_response(code)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(data)))
-            self.end_headers()
-            self.wfile.write(data)
-
-        def _respond_text(self, code: int, text: str) -> None:
-            data = text.encode("utf-8")
-            self.send_response(code)
-            self.send_header("Content-Type", "text/plain; charset=utf-8")
-            self.send_header("Content-Length", str(len(data)))
-            self.end_headers()
-            self.wfile.write(data)
-
         def do_GET(self):
             path = urlparse(self.path).path
             if path == "/stats":
-                self._respond(200, {"stats": DelayProfiler.get_stats()})
+                _send_json(self, 200, {"stats": DelayProfiler.get_stats()})
             elif path == "/metrics":
-                body = metrics() if metrics is not None else ""
-                # DelayProfiler rides along so one scrape sees both planes
-                body += "# delayprofiler " + DelayProfiler.get_stats() + "\n"
-                self._respond_text(200, body)
+                _send_text(self, 200, _metrics_body(metrics))
             else:
-                self._respond(404, {"error": "POST app requests to /"})
+                _send_json(self, 404, {"error": "POST app requests to /"})
 
         def do_POST(self):
             length = int(self.headers.get("Content-Length") or 0)
             try:
                 payload = json.loads(self.rfile.read(length) or b"{}")
             except json.JSONDecodeError:
-                self._respond(400, {"error": "bad json"})
+                _send_json(self, 400, {"error": "bad json"})
                 return
             name = payload.get("name")
             value = payload.get("request", payload.get("value"))
             if not name or value is None:
-                self._respond(400, {"error": "need name and request"})
+                _send_json(self, 400, {"error": "need name and request"})
                 return
             if overloaded is not None and overloaded():
-                self._respond(503, {"error": "overload", "name": name})
+                _send_json(self, 503, {"error": "overload", "name": name})
                 return
             ev = threading.Event()
             box: Dict = {}
@@ -200,11 +219,12 @@ def start_ar_http(
 
             vid = propose(name, str(value), cb)
             if vid is None:
-                self._respond(404, {"error": "unknown_name", "name": name})
+                _send_json(self, 404, {"error": "unknown_name", "name": name})
                 return
             if not ev.wait(timeout_s):
-                self._respond(504, {"error": "timeout"})
+                _send_json(self, 504, {"error": "timeout"})
                 return
-            self._respond(200, {"name": name, "response": box.get("response")})
+            _send_json(self, 200,
+                       {"name": name, "response": box.get("response")})
 
     return _http_server(host, port, Handler)

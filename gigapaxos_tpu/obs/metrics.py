@@ -115,6 +115,16 @@ class MetricsRegistry:
                 h = self._hists[key] = Histogram(bounds)
             h.observe(x)
 
+    def remove(self, key: str) -> None:
+        """Retire a metric series (e.g. a per-node gauge of a removed
+        cluster member): a dead label exporting its last value forever
+        reads as a live node, and membership churn would grow the
+        registry without bound."""
+        with self._lock:
+            self._counters.pop(key, None)
+            self._gauges.pop(key, None)
+            self._hists.pop(key, None)
+
     # ---- read ---------------------------------------------------------
     def get(self, key: str) -> float:
         with self._lock:

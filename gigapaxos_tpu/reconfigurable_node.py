@@ -147,6 +147,11 @@ class ActiveReplicaServer(PaxosServer):
                 self.active_replica._on_stop_executed(name, row, epoch)
             self.active_replica.tick()
 
+    def _echo_load(self) -> Dict:
+        # scalar reads only (no lock): a torn read costs one slightly
+        # stale load sample, never a crash
+        return self.active_replica.load_summary()
+
 
 class ReconfiguratorServer(PaxosServer):
     """A PaxosServer whose app is the RC-record RSM, plus the Reconfigurator
@@ -214,6 +219,8 @@ class ReconfiguratorServer(PaxosServer):
                 self.transport.listen_port
                 + Config.get_int(PC.HTTP_PORT_OFFSET),
                 submit,
+                metrics=self.manager.metrics.render,
+                stats=self._layer_stats,
             )
         except OSError:
             pass  # HTTP port taken: binary protocol still fully serves
@@ -299,6 +306,11 @@ class ReconfiguratorServer(PaxosServer):
             for op in events:
                 self._layer_on_applied(op)
             self.reconfigurator.tick()
+
+    def _layer_stats(self) -> Dict:
+        # PlacementEngine.snapshot is internally locked — safe from admin
+        # and HTTP worker threads without the layer lock
+        return {"placement": self.reconfigurator.placement.snapshot()}
 
 
 class ReconfigurableNode:

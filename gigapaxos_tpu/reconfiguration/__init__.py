@@ -15,10 +15,20 @@ recursion (``RepliconfigurableReconfiguratorDB``).
 """
 
 from .chash import ConsistentHashing
+from .placement import (
+    AbstractPlacementPolicy,
+    MeasureOnlyPlacementPolicy,
+    PlacementEngine,
+    ProximateBalancePolicy,
+)
 from .record import RCState, ReconfigurationRecord
 
 __all__ = [
+    "AbstractPlacementPolicy",
     "ConsistentHashing",
+    "MeasureOnlyPlacementPolicy",
+    "PlacementEngine",
+    "ProximateBalancePolicy",
     "RCState",
     "ReconfigurationRecord",
 ]

@@ -115,6 +115,10 @@ class ActiveReplica:
             RC.MAX_FINAL_STATE_AGE_S
         )
         self._last_demand_flush = time.time()
+        # load summary for the placement plane: EWMA of this node's
+        # request rate, updated at each demand flush and decayed between
+        # them (an idle node must read ~0, not its last busy number)
+        self._load_rps = 0.0
         self.tasks = ProtocolExecutor(
             send=lambda m: self.send(m[0], m[1], m[2])
         )
@@ -149,6 +153,15 @@ class ActiveReplica:
             self._handle_epoch_commit(body)
         elif kind == "pause_epoch":
             self._handle_pause_epoch(body)
+        elif kind == "echo":
+            # active orientation (EchoRequest analog, Reconfigurator.
+            # java:2420): bounce the prober's timestamp back so it can
+            # measure RTT, and ride this node's load summary along so one
+            # probe round gives the placement plane both signals
+            self.send(tuple(body["rc"]), "echo_reply", {
+                "from": self.my_id, "ts": body.get("ts"),
+                **self.load_summary(),
+            })
         elif kind == "epoch_gone":
             # RC's answer to an epoch_probe: the probed (name, epoch) is
             # obsolete — GC whichever stranded form this member holds (a
@@ -181,6 +194,26 @@ class ActiveReplica:
 
     # ---- demand reporting (updateDemandStats -> DemandReport,
     # ActiveReplica demand hooks / DemandReport.java) --------------------
+    def current_rps(self, now: Optional[float] = None) -> float:
+        """This node's request-rate estimate, decayed by idle time since
+        the last demand flush (served to echo probes and demand reports
+        as the placement plane's load signal)."""
+        now = time.time() if now is None else now
+        idle = max(0.0, now - self._last_demand_flush)
+        if idle <= 2 * self.demand_report_period_s:
+            return self._load_rps
+        return self._load_rps * 0.5 ** (idle / self.demand_report_period_s)
+
+    def load_summary(self) -> Dict:
+        """THE load payload — every surface that reports this node's
+        load (epoch-plane echo replies, client-plane echo replies via
+        the server hook, demand-report ride-alongs) uses this one shape
+        so the signals cannot drift apart."""
+        return {
+            "names": self.coordinator.hosted_names_count(),
+            "rps": round(self.current_rps(), 3),
+        }
+
     def _maybe_report_demand(self, now: Optional[float] = None) -> None:
         if not self.rc_ids:
             return
@@ -190,12 +223,21 @@ class ActiveReplica:
         if now - self._last_demand_flush < self.demand_report_period_s and \
                 self.coordinator.demand_backlog() < self.demand_report_every:
             return
+        drained = self.coordinator.drain_demand()
+        dt = max(1e-3, now - self._last_demand_flush)
         self._last_demand_flush = now
-        for name, (count, epoch) in self.coordinator.drain_demand().items():
+        inst = sum(c for c, _e in drained.values()) / dt
+        self._load_rps = 0.7 * self._load_rps + 0.3 * inst
+        # the load summary rides every report: the record's primary RC
+        # aggregates {names hosted, request rate} per active for the
+        # placement policies (ProximateBalance's load-balance signal)
+        load = self.load_summary()
+        for name, (count, epoch) in drained.items():
             self.send(("RC", self.rc_ids[hash(name) % len(self.rc_ids)]),
                       "demand_report", {
                           "name": name, "epoch": epoch,
                           "count": count, "from": self.my_id,
+                          "load": load,
                       })
 
     # ---- Deactivator sweep (PaxosManager.java:2931,2786) ---------------

@@ -108,6 +108,11 @@ class AbstractReplicaCoordinator:
         """Total unreported request count (early-flush trigger)."""
         raise NotImplementedError
 
+    def hosted_names_count(self) -> int:
+        """Names this node currently hosts (the placement plane's
+        names-per-active load signal, served to echo probes)."""
+        return 0
+
     def get_replica_group(self, name: str) -> Optional[List[int]]:
         raise NotImplementedError
 
@@ -239,6 +244,9 @@ class PaxosReplicaCoordinator(AbstractReplicaCoordinator):
 
     def demand_backlog(self) -> int:
         return self.manager.demand_backlog
+
+    def hosted_names_count(self) -> int:
+        return len(self.manager.names)
 
     def get_replica_group(self, name: str) -> Optional[List[int]]:
         return self.manager.get_replica_group(name)

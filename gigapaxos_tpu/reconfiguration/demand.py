@@ -106,6 +106,17 @@ class ProximityDemandProfile(DemandProfile):
     DECAY_AT = 4096      # halve history past this: locality must track
     #                      SHIFTED traffic in bounded time, not lifetime sums
 
+    def __init__(self, name: str):
+        super().__init__(name)
+        # anti-flap margin (RC.DEMAND_HYSTERESIS_MARGIN): once this
+        # profile has anchored the name somewhere, a DIFFERENT hot entry
+        # must lead the standing anchor by margin*total before the set
+        # moves again — two near-equal top regions otherwise alternate
+        # the replica set on successive demand reports (each report tips
+        # the max the other way by a handful of requests)
+        self.hysteresis_margin = Config.get_float(RC.DEMAND_HYSTERESIS_MARGIN)
+        self._anchor: Optional[int] = None  # hot entry of the last move
+
     def combine(self, report: Dict) -> None:
         super().combine(report)
         if sum(self.by_active.values()) >= self.DECAY_AT:
@@ -114,17 +125,29 @@ class ProximityDemandProfile(DemandProfile):
             }
 
     def reconfigure(self, cur_actives, all_actives):
+        # removed actives' stale history must not steer (or, as the
+        # standing anchor, VETO) locality decisions for the survivors —
+        # prune every departed entry, not just a stale max
+        live = set(all_actives)
+        if any(a not in live for a in self.by_active):
+            self.by_active = {
+                a: n for a, n in self.by_active.items() if a in live
+            }
+        if self._anchor is not None and self._anchor not in live:
+            self._anchor = None
         total = sum(self.by_active.values())
         if total < self.MIN_REQUESTS:
             return None
         hot, n = max(self.by_active.items(), key=lambda kv: kv[1])
-        if hot not in all_actives:
-            # a removed active's stale history must not block locality
-            # decisions for the survivors forever
-            del self.by_active[hot]
-            return None
         if n < total * self.DOMINANCE:
             return None
+        anchor = self._anchor if self._anchor is not None else (
+            cur_actives[0] if cur_actives else None
+        )
+        if anchor is not None and hot != anchor and \
+                n - self.by_active.get(anchor, 0) < \
+                self.hysteresis_margin * total:
+            return None  # near-equal top entries: hold the standing anchor
         region = Config.get(f"REGION.{hot}")
         if region is None:
             return None  # no region map configured: measure only
@@ -145,7 +168,9 @@ class ProximityDemandProfile(DemandProfile):
         if len(target) < len(cur_actives):
             return None  # cluster too small to keep the replica count
         if sorted(target) == sorted(cur_actives):
+            self._anchor = hot  # already placed right: remember why
             return None
+        self._anchor = hot
         return target
 
 

@@ -25,6 +25,31 @@ class RC(FlagEnum):
     DEMAND_REPORT_EVERY = 64
     # ...and at least this often while any demand is unreported
     DEMAND_REPORT_PERIOD_S = 1.0
+    # locality anti-flap: the hot entry must lead the current anchor by
+    # this fraction of total demand before ProximityDemandProfile moves
+    # an already-placed name again (two near-equal regions must not
+    # alternate the replica set on successive reports)
+    DEMAND_HYSTERESIS_MARGIN = 0.25
+
+    # ---- placement plane (ref: ProximateBalance.java heuristics +
+    # EchoRequest probing, Reconfigurator.java:2420) ---------------------
+    # dotted path of the placement policy (AbstractPlacementPolicy SPI,
+    # mirroring DEMAND_PROFILE_TYPE)
+    PLACEMENT_POLICY_TYPE = (
+        "gigapaxos_tpu.reconfiguration.placement.ProximateBalancePolicy"
+    )
+    # a displacing candidate must be lighter than the member it replaces
+    # by this fraction of the member's load (near-equal = stay put)
+    PLACEMENT_HYSTERESIS = 0.25
+    # minimum seconds between placement-driven moves of the same name
+    PLACEMENT_COOLDOWN_S = 30.0
+    # a name's EWMA request rate must reach this before balance moves it
+    # (below it, only its demand profile's locality decision applies)
+    PLACEMENT_MIN_RATE_RPS = 8.0
+    # reconfigurators echo-probe every active this often (0 disables);
+    # replies carry RTT + the active's load summary, so the RC has a
+    # latency/load picture before any real traffic
+    ECHO_PROBE_PERIOD_S = 5.0
 
     # ---- task re-drive machinery (TPU-build specific) ------------------
     REDRIVE_EVERY = 32          # reconfigurator ticks between record scans

@@ -442,6 +442,7 @@ class Reconfigurator:
         ar_n_groups: Optional[int] = None,       # row space of the AR engine
         is_node_up: Optional[Callable[[int], bool]] = None,  # RC liveness
         demand_profiler=None,  # AggregateDemandProfiler override (tests)
+        placement_policy_cls=None,  # AbstractPlacementPolicy override (tests)
     ):
         self.my_id = int(my_id)
         self.rc_manager = rc_manager
@@ -486,6 +487,19 @@ class Reconfigurator:
             AggregateDemandProfiler() if demand_profiler is None
             else demand_profiler
         )
+        # the placement plane (ProximateBalance analog): per-active load
+        # + probed-RTT signal tables and the pluggable policy, consulted
+        # at create time and on the demand-report reconfigure path.
+        # Decisions surface through the RC manager's metrics registry
+        # (stats admin op / RC /metrics)
+        from .placement import PlacementEngine
+
+        self.placement = PlacementEngine(
+            my_id, policy_cls=placement_policy_cls,
+            metrics=rc_manager.metrics,
+        )
+        self.echo_probe_period_s = Config.get_float(RC.ECHO_PROBE_PERIOD_S)
+        self._last_echo_probe = 0.0  # never probed: first tick orients
         self.tasks = ProtocolExecutor(send=lambda m: self.send(m[0], m[1], m[2]))
         # client replies owed on COMPLETE / DELETE_FINAL: name -> client addr
         self._pending_clients: Dict[str, Any] = {}
@@ -614,6 +628,8 @@ class Reconfigurator:
             self.kick_reactivate(body["name"])
         elif kind == "demand_report":
             self._handle_demand_report(body)
+        elif kind == "echo_reply":
+            self._handle_echo_reply(body)
         elif kind in ("add_active", "remove_active"):
             self._handle_membership(kind, body)
         elif kind in ("add_reconfigurator", "remove_reconfigurator"):
@@ -629,9 +645,35 @@ class Reconfigurator:
         self.tasks.tick(now)
         self._tick_count += 1
         self._advance_rc_transition()
+        self._maybe_echo_probe(now)
         if self._tick_count % self.REDRIVE_EVERY == 0:
             self._redrive_records()
             self._redrive_unfinished_drops()
+
+    # ---- active orientation (EchoRequest, Reconfigurator.java:2420) ----
+    def _maybe_echo_probe(self, now: Optional[float] = None) -> None:
+        """Periodic echo round to every live active: replies populate the
+        placement plane's RTT row and load table, so create-time
+        placement is latency/load-aware BEFORE any real traffic."""
+        if self.echo_probe_period_s <= 0:
+            return
+        now = time.time() if now is None else now
+        if now - self._last_echo_probe < self.echo_probe_period_s:
+            return
+        self._last_echo_probe = now
+        for a in sorted(self.ar_ids):
+            self.send(("AR", a), "echo", {
+                "ts": time.time(), "rc": ["RC", self.my_id],
+            })
+
+    def _handle_echo_reply(self, body: Dict) -> None:
+        ts = body.get("ts")
+        rtt = max(0.0, time.time() - float(ts)) if ts is not None else None
+        if rtt is None:
+            return
+        self.placement.note_echo(
+            int(body["from"]), rtt, body.get("names"), body.get("rps")
+        )
 
     def note_unfinished_drop(
         self, name: str, epoch: int, stragglers: List[int]
@@ -694,7 +736,13 @@ class Reconfigurator:
             if rec.state is RCState.WAIT_ACK_START and not rec.actives:
                 return "inflight"
             return {"ok": False, "reason": "exists", "actives": rec.actives}
-        actives = actives or self.ar_ring.get_replicated_servers(
+        # create-time placement: the placement policy picks from the
+        # load/latency signal tables (probed before any traffic); the
+        # consistent-hash ring stays as the fallback for a policy that
+        # returns nothing usable
+        actives = actives or self.placement.place_initial(
+            name, sorted(self.ar_ids), self.default_replicas
+        ) or self.ar_ring.get_replicated_servers(
             name, self.default_replicas
         )
         if self._bad_actives(actives):
@@ -1172,7 +1220,11 @@ class Reconfigurator:
     def _refresh_ar_ring(self) -> None:
         live = (self.rc_app.ar_nodes if self.rc_app.ar_nodes is not None
                 else self._boot_actives)
-        self.ar_ids = set(int(a) for a in live)
+        new_ids = set(int(a) for a in live)
+        for gone in self.ar_ids - new_ids:
+            # a removed active's stale load/RTT must not bias placement
+            self.placement.forget(gone)
+        self.ar_ids = new_ids
         self.ar_ring = ConsistentHashing(sorted(self.ar_ids))
 
     def _rc_set(self) -> List[int]:
@@ -1217,11 +1269,22 @@ class Reconfigurator:
         rec = self.rc_app.get_record(name)
         if rec is None or rec.deleted:
             self.demand.pop(name)
+            self.placement.note_name_gone(name)
             return
+        # the report's load summary feeds the placement plane even when
+        # no migration follows (every active's rate/names view matters)
+        self.placement.note_report(body)
         prof = self.demand.combine(name, body)
         if rec.state is not RCState.READY:
             return
         target = prof.reconfigure(list(rec.actives), sorted(self.ar_ids))
+        if not target:
+            # the locality profile declined: the placement policy may
+            # still spread a hot name onto less-loaded actives
+            # (ProximateBalance — locality first, balance second)
+            target = self.placement.rebalance(
+                name, prof, list(rec.actives), sorted(self.ar_ids)
+            )
         if not target or sorted(target) == sorted(rec.actives) or \
                 self._bad_actives(target):
             return
@@ -1701,6 +1764,7 @@ class Reconfigurator:
                 ),
             )
         elif kind == DELETE_FINAL:
+            self.placement.note_name_gone(name)
             client = self._pending_clients.pop(name, None)
             if client is not None:
                 self.send(tuple(client), "delete_ack",

@@ -185,7 +185,7 @@ class PaxosServer:
     # defeat the MUTUAL_AUTH mesh split
     CLIENT_PLANE_KINDS = frozenset((
         "client_request", "client_request_batch", "rc_client",
-        "admin", "fd_ping",
+        "admin", "fd_ping", "echo",
     ))
 
     def _on_client_plane_message(
@@ -269,6 +269,15 @@ class PaxosServer:
             self._flush_responses()
         elif k == "admin":
             self._on_admin(body, reply)
+        elif k == "echo":
+            # latency orientation (EchoRequest analog): bounce the
+            # sender's timestamp with this node's load summary, so
+            # clients seed their redirector — and peers their placement
+            # tables — before any real traffic
+            reply(encode_json("echo_reply", self.my_id, {
+                "ts": body.get("ts"), "round": body.get("round"),
+                "from": self.my_id, **self._echo_load(),
+            }))
         else:
             return False
         return True
@@ -520,14 +529,20 @@ class PaxosServer:
         elif op == "stats":
             # engine counters + DelayProfiler snapshot over the admin
             # plane — the deployed analog of the AR HTTP /stats page,
-            # reachable wherever the binary protocol is
-            reply(encode_json("admin_response", self.my_id, {
+            # reachable wherever the binary protocol is.  Layered roles
+            # (ReconfiguratorServer) ride their own plane stats along
+            # (placement loads, probe RTTs) via _layer_stats.
+            out = {
                 "op": op, "name": body.get("name"), "ok": True,
                 "tick": self._tick,
                 "engine": self.manager.metrics.snapshot(),
                 "profiler": DelayProfiler.get_snapshot(),
                 "profiler_line": DelayProfiler.get_stats(),
-            }))
+            }
+            layer = self._layer_stats()
+            if layer:
+                out["layer"] = layer
+            reply(encode_json("admin_response", self.my_id, out))
         else:
             # an unknown op must still ANSWER: silence leaves the
             # client's admin waiter parked until its timeout
@@ -724,3 +739,14 @@ class PaxosServer:
 
     def _layer_tick(self) -> None:
         """Per-tick hook for layered roles (AR/RC protocol tasks)."""
+
+    def _layer_stats(self) -> Optional[Dict]:
+        """Layered roles' contribution to the ``stats`` admin op (the RC
+        adds its placement-plane snapshot); None = nothing to add."""
+        return None
+
+    def _echo_load(self) -> Dict:
+        """This node's load summary for echo replies.  The AR role
+        overrides with its layer's `load_summary()` so the client-plane
+        and epoch-plane echo payloads stay the same shape."""
+        return {"names": len(self.manager.names)}

@@ -180,12 +180,21 @@ def run_soak(
             m.tracer.enabled = True
         for rc_l in c.reconfigurators:
             rc_l.tracer.enabled = True
+        from ..reconfiguration.placement import MeasureOnlyPlacementPolicy
+
         for rc in c.reconfigurators:
             rc.REDRIVE_EVERY = 4
             # compress the slow READY-audit cadence to the soak's
             # timescale (like the 0.05s task retransmits): audit-healed
             # shapes must fit inside the settle budget
             rc.ready_audit_period_s = 2.0
+            # pin the seeds' message universe: echo probes would consume
+            # draws from the SHARED fault rng (re-rolling every recorded
+            # shape), and placement-driven migrations would add moves the
+            # recorded schedules never contained — the placement plane
+            # has its own suite (tests/test_placement.py)
+            rc.echo_probe_period_s = 0.0
+            rc.placement.policy = MeasureOnlyPlacementPolicy(rc.placement)
         names = [f"n{i}" for i in range(n_names)]
 
         def step():

@@ -33,6 +33,7 @@ class ReconfigurableCluster:
         rc_log_dirs: Optional[List[str]] = None,
         demand_profile_cls=None,
         rc_members: Optional[List[int]] = None,
+        placement_policy_cls=None,
     ):
         """``rc_members`` boots the record RSM on a SUBSET of the RC nodes;
         the rest run as standbys addressable for a later runtime
@@ -77,6 +78,7 @@ class ReconfigurableCluster:
                     AggregateDemandProfiler(demand_profile_cls)
                     if demand_profile_cls else None
                 ),
+                placement_policy_cls=placement_policy_cls,
             ))
         # bootstrap the RC-record RSM on every reconfigurator (the
         # AR_RC_NODES-style special group, created deterministically);

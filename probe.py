@@ -52,6 +52,10 @@ def main() -> int:
                          "process per node — the realistic deployment "
                          "shape; in-process shares one GIL across six "
                          "tick loops and saturates early)")
+    ap.add_argument("--attach", metavar="PROPS", default=None,
+                    help="probe an ALREADY-RUNNING cluster booted from "
+                         "this properties file (scripts/gp_server.py "
+                         "start all) instead of booting nodes here")
     args = ap.parse_args()
 
     if args.cpu:
@@ -73,18 +77,26 @@ def main() -> int:
     )
     from gigapaxos_tpu.utils.config import Config
 
-    ports = free_ports(6)
     Config.clear()
-    for i in range(3):
-        Config.set(f"active.AR{i}", f"127.0.0.1:{ports[i]}")
-        Config.set(f"reconfigurator.RC{i}", f"127.0.0.1:{ports[3 + i]}")
+    if args.attach:
+        # ops-parity mode: the cluster is already up (gp_server.py) —
+        # build only the client's address book from the scenario file
+        Config.load_file(args.attach)
+    else:
+        ports = free_ports(6)
+        for i in range(3):
+            Config.set(f"active.AR{i}", f"127.0.0.1:{ports[i]}")
+            Config.set(f"reconfigurator.RC{i}",
+                       f"127.0.0.1:{ports[3 + i]}")
     if args.unreplicated:
         Config.set("EMULATE_UNREPLICATED", "true")
         os.environ["GP_EMULATE_UNREPLICATED"] = "true"  # child processes
     node_names = [f"{r}{i}" for r in ("AR", "RC") for i in range(3)]
     nodes = []
     procs = []
-    if args.in_process:
+    if args.attach:
+        pass  # nothing to boot
+    elif args.in_process:
         from gigapaxos_tpu.models.apps import NoopPaxosApp
         from gigapaxos_tpu.ops.engine import EngineConfig
         from gigapaxos_tpu.reconfigurable_node import ReconfigurableNode
@@ -182,6 +194,11 @@ def main() -> int:
             os.unlink(props.name)
             return 1
     client = ReconfigurableAppClient.from_properties()
+    # echo-probe the actives FIRST: the redirector's estimates are seeded
+    # before any real traffic, so even the warm-up requests route to the
+    # measured-nearest active (placement-plane client orientation)
+    seeded = client.probe_actives(wait_s=3.0)
+    print(json.dumps({"echo_probe_seeded_actives": seeded}), flush=True)
     names = [f"probe{i}" for i in range(args.groups)]
     for nm in names:
         ack = client.create_name(nm, actives=[0, 1, 2], timeout=60)

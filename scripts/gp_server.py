@@ -1,0 +1,237 @@
+#!/usr/bin/env python
+"""Cluster launcher: start/stop/status for ReconfigurableNode processes.
+
+Ops parity with the reference's ``bin/gpServer.sh:1-60`` (``gpServer.sh
+start all`` boots every node named in the properties file, one JVM per
+node; ``stop all`` kills them), driving the real
+``python -m gigapaxos_tpu.reconfigurable_node`` CLI:
+
+    scripts/gp_server.py --config scenarios/loopback_3ar_3rc.properties \
+        start all            # one OS process per active.*/reconfigurator.*
+    scripts/gp_server.py --config ... status all
+    scripts/gp_server.py --config ... stop all
+    scripts/gp_server.py --config ... start AR0 RC1   # named subset
+
+State lives under ``--run-dir`` (default ``gp_run/`` next to the config):
+``<name>.pid`` + ``<name>.log`` per node.  ``start`` waits until every
+booted node's listener accepts (or reports the log tail of whichever
+node died); ``stop`` SIGTERMs, waits, then SIGKILLs stragglers.
+
+Node processes inherit the environment; JAX_PLATFORMS defaults to
+``cpu`` when unset (N control-plane processes must not fight over one
+accelerator — same policy as probe.py's child processes; export
+JAX_PLATFORMS yourself to override).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from gigapaxos_tpu.utils.config import parse_properties  # noqa: E402
+
+
+def load_nodes(config: Path) -> Dict[str, Tuple[str, int]]:
+    """{node name: (host, port)} from active.* / reconfigurator.* lines.
+    A name holding both roles (one process, two servers) appears once."""
+    props = parse_properties(config.read_text(encoding="utf-8"))
+    nodes: Dict[str, Tuple[str, int]] = {}
+    for key, val in props.items():
+        for prefix in ("active.", "reconfigurator."):
+            if key.startswith(prefix):
+                host, _, port = val.partition(":")
+                nodes.setdefault(key[len(prefix):], (host, int(port)))
+    return nodes
+
+
+def pid_file(run_dir: Path, name: str) -> Path:
+    return run_dir / f"{name}.pid"
+
+
+def read_pid(run_dir: Path, name: str) -> Optional[int]:
+    try:
+        return int(pid_file(run_dir, name).read_text().strip())
+    except (OSError, ValueError):
+        return None
+
+
+def pid_alive(pid: Optional[int]) -> bool:
+    """True when `pid` is alive AND is one of ours.  A stale pidfile
+    whose PID the OS recycled for an unrelated process must not make
+    `stop` kill an innocent bystander or `start` report
+    'already running' — on Linux the /proc cmdline must name the node
+    module; where /proc is unavailable, fall back to liveness only."""
+    if pid is None:
+        return False
+    try:
+        os.kill(pid, 0)
+    except (ProcessLookupError, PermissionError):
+        return False
+    try:
+        cmdline = Path(f"/proc/{pid}/cmdline").read_bytes()
+    except OSError:
+        return True  # no /proc: best-effort liveness
+    return b"reconfigurable_node" in cmdline
+
+
+def kill_quietly(pid: int, sig: int) -> None:
+    """Signal a process that may exit between check and kill."""
+    try:
+        os.kill(pid, sig)
+    except (ProcessLookupError, PermissionError):
+        pass
+
+
+def port_up(addr: Tuple[str, int], timeout: float = 0.2) -> bool:
+    try:
+        with socket.create_connection(addr, timeout):
+            return True
+    except OSError:
+        return False
+
+
+def pick(nodes: Dict[str, Tuple[str, int]], wanted: List[str]) -> List[str]:
+    if wanted == ["all"] or not wanted:
+        return sorted(nodes)
+    unknown = [w for w in wanted if w not in nodes]
+    if unknown:
+        raise SystemExit(
+            f"unknown node(s) {unknown}; config defines {sorted(nodes)}"
+        )
+    return wanted
+
+
+def do_start(args, nodes: Dict[str, Tuple[str, int]]) -> int:
+    run_dir: Path = args.run_dir
+    run_dir.mkdir(parents=True, exist_ok=True)
+    env = dict(os.environ)
+    env["GIGAPAXOS_CONFIG"] = str(args.config)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    started: List[str] = []
+    for name in pick(nodes, args.nodes):
+        if pid_alive(read_pid(run_dir, name)):
+            print(f"{name}: already running (pid {read_pid(run_dir, name)})")
+            continue
+        log = open(run_dir / f"{name}.log", "a")
+        cmd = [sys.executable, "-m", "gigapaxos_tpu.reconfigurable_node"]
+        if args.clean:
+            cmd.append("-c")
+        cmd.append(name)
+        proc = subprocess.Popen(
+            cmd, env=env, stdout=log, stderr=log,
+            start_new_session=True,  # survives this launcher's terminal
+        )
+        log.close()
+        pid_file(run_dir, name).write_text(str(proc.pid))
+        started.append(name)
+        print(f"{name}: started pid {proc.pid} -> {nodes[name]}")
+    # readiness: every started node's listener must accept
+    deadline = time.time() + args.wait_s
+    pending = set(started)
+    while pending and time.time() < deadline:
+        for name in sorted(pending):
+            if not pid_alive(read_pid(run_dir, name)):
+                tail = (run_dir / f"{name}.log").read_text(
+                    encoding="utf-8", errors="replace"
+                )[-2000:]
+                print(f"{name}: DIED during startup; log tail:\n{tail}")
+                return 1
+            if port_up(nodes[name]):
+                pending.discard(name)
+        if pending:
+            time.sleep(0.3)
+    if pending:
+        print(f"timeout: not listening after {args.wait_s}s: "
+              f"{sorted(pending)}")
+        return 1
+    if started:
+        print(f"up: {sorted(started)}")
+    return 0
+
+
+def do_stop(args, nodes: Dict[str, Tuple[str, int]]) -> int:
+    run_dir: Path = args.run_dir
+    victims = []
+    for name in pick(nodes, args.nodes):
+        pid = read_pid(run_dir, name)
+        if not pid_alive(pid):
+            print(f"{name}: not running")
+            pid_file(run_dir, name).unlink(missing_ok=True)
+            continue
+        kill_quietly(pid, signal.SIGTERM)
+        victims.append((name, pid))
+    deadline = time.time() + args.wait_s
+    for name, pid in victims:
+        while pid_alive(pid) and time.time() < deadline:
+            time.sleep(0.2)
+        if pid_alive(pid):
+            print(f"{name}: SIGKILL after {args.wait_s}s grace")
+            kill_quietly(pid, signal.SIGKILL)
+        pid_file(run_dir, name).unlink(missing_ok=True)
+        print(f"{name}: stopped")
+    return 0
+
+
+def do_status(args, nodes: Dict[str, Tuple[str, int]]) -> int:
+    run_dir: Path = args.run_dir
+    all_up = True
+    for name in pick(nodes, args.nodes):
+        pid = read_pid(run_dir, name)
+        alive = pid_alive(pid)
+        listening = alive and port_up(nodes[name])
+        state = ("up" if listening
+                 else "starting" if alive else "down")
+        all_up = all_up and listening
+        print(f"{name}: {state}"
+              + (f" (pid {pid}, {nodes[name][0]}:{nodes[name][1]})"
+                 if alive else ""))
+    return 0 if all_up else 3
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="start/stop/status for a ReconfigurableNode cluster "
+                    "(bin/gpServer.sh analog)"
+    )
+    ap.add_argument("--config", type=Path,
+                    default=Path("gigapaxos.properties"),
+                    help="properties file with active.*/reconfigurator.* "
+                         "address book (GIGAPAXOS_CONFIG for the nodes)")
+    ap.add_argument("--run-dir", type=Path, default=None,
+                    help="pid/log directory (default: gp_run/ next to "
+                         "the config)")
+    ap.add_argument("--wait-s", type=float, default=60.0,
+                    help="start: listener-readiness timeout; stop: "
+                         "SIGTERM grace before SIGKILL")
+    ap.add_argument("--clean", action="store_true",
+                    help="start nodes clean-slate (-c: wipe their "
+                         "durable state first)")
+    ap.add_argument("action", choices=("start", "stop", "status"))
+    ap.add_argument("nodes", nargs="*", default=["all"],
+                    help="'all' (default) or node names from the config")
+    args = ap.parse_args(argv)
+    if not args.config.exists():
+        print(f"no such config: {args.config}")
+        return 2
+    if args.run_dir is None:
+        args.run_dir = args.config.resolve().parent / "gp_run"
+    nodes = load_nodes(args.config)
+    if not nodes:
+        print(f"{args.config}: no active.*/reconfigurator.* entries")
+        return 2
+    return {"start": do_start, "stop": do_stop, "status": do_status}[
+        args.action
+    ](args, nodes)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,75 @@
+"""Ops-launcher smoke (slow): scripts/gp_server.py boots the 3AR+3RC
+loopback scenario from its properties pair, probe.py completes a short
+capacity pass attached to it, and stop tears everything down cleanly —
+the ``bin/gpServer.sh start all`` / ``TESTPaxosClient`` loop, end to end
+over real OS processes."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from gigapaxos_tpu.testing.ports import free_ports
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _run(args, timeout):
+    return subprocess.run(
+        args, cwd=REPO, timeout=timeout, capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+
+
+@pytest.mark.slow
+def test_gp_server_start_probe_stop(tmp_path):
+    # the committed scenario pins ports for operators; the test rewrites
+    # them to free ephemerals so parallel CI runs can't collide
+    scenario = (REPO / "scenarios/loopback_3ar_3rc.properties").read_text()
+    ports = free_ports(6)
+    for i, (old, new) in enumerate(zip(
+        ("21000", "21001", "21002", "22000", "22001", "22002"),
+        (str(p) for p in ports),
+    )):
+        scenario = scenario.replace(f":{old}", f":{new}")
+    cfg = tmp_path / "smoke.properties"
+    cfg.write_text(scenario)
+    run_dir = tmp_path / "run"
+    gp = [sys.executable, "scripts/gp_server.py",
+          "--config", str(cfg), "--run-dir", str(run_dir)]
+    try:
+        r = _run(gp + ["start", "all"], timeout=180)
+        assert r.returncode == 0, (r.stdout, r.stderr)
+        assert "up:" in r.stdout
+
+        r = _run(gp + ["status", "all"], timeout=60)
+        assert r.returncode == 0, (r.stdout, r.stderr)
+        assert r.stdout.count(": up") == 6, r.stdout
+
+        r = _run(
+            [sys.executable, "probe.py", "--attach", str(cfg), "--cpu",
+             "--groups", "2", "--clients", "2", "--max-rounds", "1",
+             "--window-s", "1.0", "--init-load", "50"],
+            timeout=300,
+        )
+        assert r.returncode == 0, (r.stdout, r.stderr)
+        lines = [json.loads(ln) for ln in r.stdout.splitlines()
+                 if ln.startswith("{")]
+        seeded = next(
+            ln for ln in lines if "echo_probe_seeded_actives" in ln
+        )
+        assert seeded["echo_probe_seeded_actives"] == 3
+        summary = next(
+            ln for ln in lines
+            if ln.get("metric") == "system_capacity_requests_per_s"
+        )
+        assert summary["value"] > 0, lines
+    finally:
+        r = _run(gp + ["stop", "all"], timeout=120)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert not list(run_dir.glob("*.pid")), "pidfiles leaked after stop"
+    r = _run(gp + ["status", "all"], timeout=60)
+    assert r.stdout.count(": down") == 6, r.stdout

@@ -1,0 +1,384 @@
+"""Placement plane (ProximateBalance analog + EchoRequest probing):
+decision-level policy behavior (hot-spot spreading, hysteresis, cooldown),
+the echo-probe RTT/load matrix, placement-driven migration end to end,
+and stats surfacing through the ``stats`` admin op and the RC HTTP front.
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from gigapaxos_tpu.models.apps import HashChainApp
+from gigapaxos_tpu.obs.metrics import MetricsRegistry
+from gigapaxos_tpu.ops.engine import EngineConfig
+from gigapaxos_tpu.reconfiguration import RCState
+from gigapaxos_tpu.reconfiguration.placement import (
+    PlacementEngine,
+    ProximateBalancePolicy,
+)
+from gigapaxos_tpu.testing.rc_cluster import ReconfigurableCluster
+
+
+class FakeProfile:
+    """Stand-in demand profile: just the signal fields policies read."""
+
+    def __init__(self, rate=30.0, num_requests=512, by_active=None):
+        self.rate = rate
+        self.num_requests = num_requests
+        if by_active is not None:
+            self.by_active = by_active
+
+
+# ---- decision level: initial placement -------------------------------
+def test_place_initial_prefers_least_loaded_then_nearest():
+    e = PlacementEngine(0)
+    e.note_echo(0, 0.030, names=50, rps=40.0)
+    e.note_echo(1, 0.020, names=2, rps=0.0)
+    e.note_echo(2, 0.010, names=2, rps=0.0)
+    e.note_echo(3, 0.040, names=60, rps=80.0)
+    target = e.place_initial("svc", [0, 1, 2, 3], 2)
+    # the two lightly-loaded actives win; nearest (2) anchors first
+    assert target == [2, 1]
+    # deterministic: same signals -> same answer (assigned ticked up, but
+    # both chosen actives moved together so the ORDER stays stable)
+    assert e.place_initial("svc", [0, 1, 2, 3], 2) == [2, 1]
+
+
+def test_place_initial_spreads_create_bursts_via_assigned():
+    """With no load reports at all, a burst of creates must not pile onto
+    one active: the decision-time `assigned` counter steers later creates
+    toward actives earlier creates skipped."""
+    e = PlacementEngine(0)
+    per_active = {a: 0 for a in range(6)}
+    for i in range(60):
+        for a in e.place_initial(f"n{i}", list(range(6)), 3):
+            per_active[a] += 1
+    assert all(n > 0 for n in per_active.values()), per_active
+    assert max(per_active.values()) <= 2 * min(per_active.values()), \
+        per_active
+
+
+# ---- decision level: hot-spot spreading ------------------------------
+def test_hot_names_spread_across_actives():
+    """The tentpole acceptance shape, decision level: >=64 hot names all
+    sitting on the same three overloaded actives spread across the idle
+    rest of the cluster via rebalance decisions."""
+    m = MetricsRegistry(node=0)
+    e = PlacementEngine(0, metrics=m)
+    busy, idle = [0, 1, 2], [3, 4, 5, 6, 7]
+    for a in busy:
+        e.note_echo(a, 0.010, names=64, rps=50.0)
+    for a in idle:
+        e.note_echo(a, 0.010, names=0, rps=0.0)
+    landed = {a: 0 for a in range(8)}
+    moves = 0
+    for i in range(64):
+        prof = FakeProfile(rate=30.0, num_requests=512,
+                           by_active={0: 40, 1: 30, 2: 30})
+        target = e.rebalance(f"hot{i}", prof, list(busy), list(range(8)))
+        if target is None:
+            continue
+        moves += 1
+        for a in target:
+            landed[a] += 1
+    assert moves >= 64 * 3 // 4, f"only {moves}/64 names moved"
+    touched = [a for a in idle if landed[a] > 0]
+    assert len(touched) >= 3, (landed, "spread must reach >=3 actives")
+    # balance: no idle active hoards the hot set
+    per_idle = [landed[a] for a in idle]
+    assert max(per_idle) <= 3 * (sum(per_idle) // len(per_idle) + 1), \
+        landed
+    assert m.get("placement_moves_proposed") == moves
+
+
+def test_rebalance_hysteresis_no_flap_on_near_equal():
+    """Near-equal candidates must not move a name at all — and a move
+    that DID happen must not bounce back on the next report."""
+    m = MetricsRegistry(node=0)
+    e = PlacementEngine(0, metrics=m)
+    e.cooldown_s = 0.0  # isolate hysteresis from the cooldown guard
+    for a in (0, 1, 2):
+        e.note_echo(a, 0.010, names=10, rps=10.0)
+    for a in (3, 4, 5):
+        e.note_echo(a, 0.010, names=9, rps=9.0)  # near-equal: within margin
+    prof = FakeProfile(rate=30.0, num_requests=512)
+    assert e.rebalance("n", prof, [0, 1, 2], list(range(6))) is None
+    assert m.get("placement_suppressed_hysteresis") == 1
+    # now a REAL imbalance: the name moves once...
+    for a in (3, 4, 5):
+        e.note_echo(a, 0.010, names=0, rps=0.0)
+    target = e.rebalance("n", prof, [0, 1, 2], list(range(6)))
+    assert target is not None and set(target) == {3, 4, 5}
+    # ...and immediately re-evaluating from the NEW set proposes nothing
+    # (the destination now carries the name: no flap back)
+    assert e.rebalance("n", prof, target, list(range(6))) is None
+
+
+def test_rebalance_cooldown_blocks_consecutive_moves():
+    m = MetricsRegistry(node=0)
+    e = PlacementEngine(0, metrics=m)  # default cooldown: 30s
+    for a in (0, 1, 2):
+        e.note_echo(a, 0.010, names=30, rps=50.0)
+    for a in (3, 4, 5):
+        e.note_echo(a, 0.010, names=0, rps=0.0)
+    prof = FakeProfile(rate=30.0, num_requests=512)
+    first = e.rebalance("n", prof, [0, 1, 2], list(range(6)))
+    assert first is not None
+    # the load picture still screams "move" — cooldown holds the name
+    assert e.rebalance("n", prof, [0, 1, 2], list(range(6))) is None
+    assert m.get("placement_suppressed_cooldown") == 1
+
+
+def test_rebalance_keeps_dominant_entry_anchor():
+    """PROXIMATE balance: the name's dominant-entry active (where its
+    clients are) is never displaced for load — otherwise balance evicts
+    the anchor that the locality profile re-adds on the next report and
+    the two deciders oscillate the name forever."""
+    e = PlacementEngine(0)
+    e.cooldown_s = 0.0
+    for a in (0, 1, 2):
+        e.note_echo(a, 0.010, names=40, rps=50.0)  # all members loaded
+    for a in (3, 4, 5):
+        e.note_echo(a, 0.010, names=0, rps=0.0)
+    prof = FakeProfile(rate=30.0, num_requests=512,
+                       by_active={0: 90, 1: 5, 2: 5})
+    target = e.rebalance("n", prof, [0, 1, 2], list(range(6)))
+    # members 1 and 2 flee the load; the entry anchor 0 stays
+    assert target is not None and 0 in target, target
+    assert set(target) - {0} <= {3, 4, 5}, target
+
+
+def test_rebalance_never_shrinks_set_on_membership_loss():
+    """A member leaving the cluster must not let balance propose a
+    SMALLER replica set (the never-shrink rule): rehoming after
+    membership loss belongs to the READY re-drive, not placement."""
+    m = MetricsRegistry(node=0)
+    e = PlacementEngine(0, metrics=m)
+    e.cooldown_s = 0.0
+    e.note_echo(0, 0.010, names=40, rps=50.0)
+    e.note_echo(1, 0.010, names=40, rps=50.0)
+    e.note_echo(3, 0.010, names=0, rps=0.0)
+    prof = FakeProfile(rate=30.0, num_requests=512)
+    # active 2 is gone from the cluster: [0,1,2] filtered would be a
+    # 2-replica proposal — must decline instead
+    assert e.rebalance("n", prof, [0, 1, 2], [0, 1, 3]) is None
+    assert m.get("placement_suppressed_short_set") == 1
+
+
+def test_placement_avoids_stale_dead_actives():
+    """An active whose echo replies STOPPED is not 'idle', it is likely
+    down — its frozen near-zero load must not make it the preferred
+    target for every create and hot-name move."""
+    e = PlacementEngine(0)  # default probing: 5s period -> 20s staleness
+    e.cooldown_s = 0.0
+    for a in (0, 1, 2):
+        e.note_echo(a, 0.010, names=20, rps=20.0)
+    e.note_echo(3, 0.010, names=0, rps=0.0)
+    e.loads[3].last_seen = time.time() - 999  # echoes stopped
+    assert 3 not in e.place_initial("n", [0, 1, 2, 3], 3)
+    # ...but freshness never shrinks the replica count: asking for 4
+    # tops back up with the stale node rather than under-replicating
+    assert sorted(e.place_initial("n4", [0, 1, 2, 3], 4)) == [0, 1, 2, 3]
+    prof = FakeProfile(rate=30.0, num_requests=512)
+    target = e.rebalance("n", prof, [0, 1, 2], [0, 1, 2, 3])
+    assert target is None or 3 not in target
+    # the node resurfaces (echo replies resume): eligible again
+    e.note_echo(3, 0.010, names=0, rps=0.0)
+    assert 3 in e.rebalance("n", prof, [0, 1, 2], [0, 1, 2, 3])
+
+
+def test_rebalance_cold_names_stay_put():
+    """Below the hot gates (count AND rate), balance never moves a name —
+    locality/noise is the demand profile's business, not placement's."""
+    e = PlacementEngine(0)
+    for a in (0, 1, 2):
+        e.note_echo(a, 0.010, names=50, rps=50.0)
+    e.note_echo(3, 0.010, names=0, rps=0.0)
+    assert e.rebalance(
+        "cold", FakeProfile(rate=0.1, num_requests=512), [0, 1, 2],
+        [0, 1, 2, 3],
+    ) is None
+    assert e.rebalance(
+        "young", FakeProfile(rate=50.0, num_requests=8), [0, 1, 2],
+        [0, 1, 2, 3],
+    ) is None
+
+
+# ---- locality-profile hysteresis (the flap regression) ----------------
+def test_proximity_profile_hysteresis_no_alternation():
+    """Regression for the demand-flap: two top entries within the margin
+    must NOT alternate the replica set on successive reports; a decisive
+    shift must still move it."""
+    from gigapaxos_tpu.reconfiguration.demand import ProximityDemandProfile
+    from gigapaxos_tpu.utils.config import Config
+
+    Config.set("REGION.0", "east")
+    Config.set("REGION.1", "east")
+    Config.set("REGION.2", "west")
+    Config.set("REGION.3", "west")
+    p = ProximityDemandProfile("n")
+    # anchored east: entry 0 dominates
+    p.by_active = {0: 300, 2: 280}
+    p.num_requests = 580
+    assert p.reconfigure([0, 1, 2], [0, 1, 2, 3]) is None  # already right
+    # the max tips to entry 2 by a hair (within margin): MUST stay put —
+    # without the margin this proposed [2, 3, ...] and the next report
+    # would tip back, flapping the set every report
+    p.by_active = {0: 290, 2: 312}
+    assert p.reconfigure([0, 1, 2], [0, 1, 2, 3]) is None
+    # a decisive shift west still migrates, anchored at the hot entry
+    p.by_active = {0: 50, 2: 550}
+    target = p.reconfigure([0, 1, 2], [0, 1, 2, 3])
+    assert target is not None and target[0] == 2 and 3 in target
+
+
+# ---- echo probes in the loopback reconfiguration cluster --------------
+def make_cluster(**kw):
+    ar_cfg = EngineConfig(n_groups=16, window=8, req_lanes=4, n_replicas=4)
+    rc_cfg = EngineConfig(n_groups=8, window=8, req_lanes=4, n_replicas=3)
+    return ReconfigurableCluster(ar_cfg, rc_cfg, HashChainApp, **kw)
+
+
+def test_echo_probes_populate_rtt_matrix_before_traffic():
+    """Every RC's placement engine learns RTT + load for every active
+    from echo rounds alone — no client traffic anywhere."""
+    c = make_cluster()
+    try:
+        for rc in c.reconfigurators:
+            rc.echo_probe_period_s = 0.01
+        for _ in range(8):
+            c.step()
+        for rc in c.reconfigurators:
+            snap = rc.placement.snapshot()
+            assert set(snap["probe_rtt_ms"]) == {"0", "1", "2", "3"}, snap
+            assert set(snap["loads"]) == {"0", "1", "2", "3"}, snap
+            for a in c.ar_ids:
+                assert rc.placement.rtt.get(a) is not None
+    finally:
+        c.close()
+
+
+# ---- placement-driven migration end to end ---------------------------
+class EagerBalancePolicy(ProximateBalancePolicy):
+    """Production policy with test-speed hot gates."""
+
+    MIN_REQUESTS = 24
+
+
+def test_placement_rebalance_migrates_hot_name_e2e():
+    """Full pipeline: AR demand reports (with load summaries) + echo
+    rounds -> the primary RC's placement engine -> RECONFIGURE_INTENT ->
+    epoch migration.  A hot name sharing three loaded actives picks up
+    the idle fourth via the placement plane's decision."""
+    from gigapaxos_tpu.utils.config import Config
+
+    Config.set("PLACEMENT_MIN_RATE_RPS", "0.1")
+    c = make_cluster(placement_policy_cls=EagerBalancePolicy)
+    try:
+        for ar in c.active_replicas:
+            ar.demand_report_period_s = 0.05
+        for rc in c.reconfigurators:
+            rc.echo_probe_period_s = 0.1
+        # fillers load actives 0-2 (names-hosted signal); 3 stays idle
+        for i in range(6):
+            c.client_request(
+                "create_service", {"name": f"bg{i}", "actives": [0, 1, 2]}
+            )
+            assert c.wait_for("create_ack", max_steps=120)["ok"]
+        c.client_request(
+            "create_service", {"name": "hx", "actives": [0, 1, 2]}
+        )
+        assert c.wait_for("create_ack", max_steps=120)["ok"]
+
+        deadline = time.time() + 40
+        rec = None
+        i = 0
+        while time.time() < deadline:
+            i += 1
+            c.ars.managers[0].propose("hx", f"v{i}")
+            c.step()
+            rec = c.reconfigurators[0].rc_app.get_record("hx")
+            if rec.state is RCState.READY and rec.epoch >= 1 \
+                    and 3 in rec.actives:
+                break
+        assert rec is not None and 3 in rec.actives, (
+            f"placement never spread onto the idle active: {rec.to_json()}"
+        )
+        assert len(rec.actives) == 3
+    finally:
+        c.close()
+
+
+# ---- stats surfacing over real sockets --------------------------------
+def test_placement_stats_surface_admin_http_and_client_seeding():
+    """One AR + one RC over loopback sockets: the RC's ``stats`` admin op
+    carries the placement snapshot, the RC HTTP front serves it on
+    /stats and its gauges on /metrics, and a client's echo probes seed
+    the redirector BEFORE any request traffic."""
+    from gigapaxos_tpu.clients import PaxosClientAsync
+    from gigapaxos_tpu.clients.reconfigurable_client import (
+        ReconfigurableAppClient,
+    )
+    from gigapaxos_tpu.models import NoopPaxosApp
+    from gigapaxos_tpu.paxos_config import PC
+    from gigapaxos_tpu.reconfigurable_node import ReconfigurableNode
+    from gigapaxos_tpu.testing.ports import free_ports
+    from gigapaxos_tpu.utils.config import Config
+
+    ports = free_ports(2)
+    Config.set("active.AR0", f"127.0.0.1:{ports[0]}")
+    Config.set("reconfigurator.RC0", f"127.0.0.1:{ports[1]}")
+    Config.set("ECHO_PROBE_PERIOD_S", "0.2")
+    cfg = EngineConfig(n_groups=16, window=8, req_lanes=4, n_replicas=1)
+    nodes = [
+        ReconfigurableNode("AR0", NoopPaxosApp, ar_cfg=cfg, rc_cfg=cfg,
+                           tick_interval=0.01),
+        ReconfigurableNode("RC0", NoopPaxosApp, ar_cfg=cfg, rc_cfg=cfg,
+                           tick_interval=0.01),
+    ]
+    for n in nodes:
+        n.start()
+    admin = PaxosClientAsync([("127.0.0.1", ports[1])])
+    app_client = ReconfigurableAppClient(
+        {0: ("127.0.0.1", ports[0])}, [("127.0.0.1", ports[1])]
+    )
+    try:
+        # client orientation: probes seed the redirector with NO traffic
+        assert app_client.probe_actives(wait_s=5.0) == 1
+        assert app_client.redirector.rtt.get(0) is not None
+
+        # RC stats admin op: placement snapshot with probe RTT + load
+        deadline = time.time() + 30
+        layer = None
+        while time.time() < deadline:
+            r = admin.admin_sync(0, {"op": "stats"}, timeout=10)
+            layer = (r or {}).get("layer")
+            if layer and layer["placement"]["probe_rtt_ms"].get("0"):
+                break
+            time.sleep(0.2)
+        assert layer, "stats admin op never carried placement"
+        placement = layer["placement"]
+        assert placement["policy"] == "ProximateBalancePolicy"
+        assert placement["probe_rtt_ms"].get("0") is not None
+        assert placement["loads"].get("0") is not None
+
+        # RC HTTP front: /stats (snapshot) + /metrics (gauges)
+        http = ports[1] + Config.get_int(PC.HTTP_PORT_OFFSET)
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{http}/stats", timeout=10
+        ) as resp:
+            body = json.loads(resp.read())
+        assert body["placement"]["probe_rtt_ms"].get("0") is not None
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{http}/metrics", timeout=10
+        ) as resp:
+            text = resp.read().decode()
+        assert "gp_probe_rtt_ms_active_0" in text
+        assert "gp_placement_echo_replies_total" in text
+    finally:
+        admin.close()
+        app_client.close()
+        for n in nodes:
+            n.stop()

@@ -180,3 +180,28 @@ def test_rtt_redirector_prefers_fast_server():
     assert rd.pick([0, 1, 2]) == 1
     # unknown candidates get measured before exploitation settles
     assert rd.pick([0, 1, 7]) == 7
+
+
+def test_rtt_redirector_seeding_and_deterministic_ties():
+    """Cold-start fix: echo-probe seeds orient the FIRST pick, never
+    overwrite traffic-learned estimates, and exact-RTT ties break
+    deterministically (same measurements -> same pick, every client)."""
+    from gigapaxos_tpu.net.rtt import LatencyAwareRedirector
+
+    rd = LatencyAwareRedirector()
+    rd.PROBE_RATIO = 0.0
+    # probe seeds land before any traffic: first pick is oriented
+    assert rd.seed(2, 0.003) and rd.seed(0, 0.050) and rd.seed(1, 0.020)
+    assert rd.pick([0, 1, 2]) == 2
+    # real traffic taught key 2 its true (slower) end-to-end number...
+    for _ in range(50):
+        rd.record(2, 0.200)
+    # ...and a later probe round must NOT drag it back down
+    assert rd.seed(2, 0.003) is False
+    assert rd.pick([0, 1, 2]) == 1
+    # exact ties break deterministically toward the stable-lowest key
+    rd2 = LatencyAwareRedirector()
+    rd2.PROBE_RATIO = 0.0
+    for k in (3, 1, 2):
+        rd2.seed(k, 0.010)
+    assert all(rd2.pick([3, 1, 2]) == 1 for _ in range(10))
