@@ -151,7 +151,7 @@ class SlimRequest(RequestPacket):
 
 
 def execute_uncoordinated(app, names, name: str, value: str, request_id,
-                          callback) -> Optional[bool]:
+                          callback, gate=None) -> Optional[bool]:
     """Uncoordinated local execution (linearizable-writes / local-reads
     apps, ref ``LinWritesLocReadsApp.java:26-44``): when the app declares
     a request uncoordinated via ``is_coordinated``, answer it from THIS
@@ -168,6 +168,11 @@ def execute_uncoordinated(app, names, name: str, value: str, request_id,
         return None
     if names.get(name) is None:
         return False
+    if gate is not None and not gate(name):
+        # un-hydrated name (recovery plane): reading its app state now
+        # would serve the pre-restore blank — fall through to the
+        # coordinated path, which queues until hydration lands
+        return None
     req = SlimRequest(name, int(request_id or 0), value)
     app.execute(req, do_not_reply_to_client=False)
     if callback is not None:
@@ -429,6 +434,15 @@ class PaxosManager:
         # a donor's app state even at EQUAL frontiers.
         self._needs_state: set = set()
 
+        # recovery plane: rows whose app state is still on disk (their
+        # checkpoint shard is the idle form, like a paused group's
+        # journal record) — gated out of admission, execution, local
+        # reads, pause snapshots, checkpoint writes, and donor serving
+        # until the hydrator restores them
+        self.hydrating_rows: set = set()
+        self.hydrator = None  # recovery.hydration.Hydrator while cold
+        self._recovery_stats: Dict[str, Any] = {}
+
         # serializes self.state replacement between the tick loop and
         # lifecycle ops arriving on transport threads (create/kill/recover)
         self._state_lock = threading.RLock()
@@ -472,9 +486,11 @@ class PaxosManager:
     def _recover(self) -> None:
         if self.logger is None:
             return
+        t_recover = time.monotonic()
         seed = {k: np.asarray(v).copy() for k, v in self.state._asdict().items()}
         rec = self.logger.recover(
-            self.cfg.window, seed_arrays=seed, my_id=self.my_id
+            self.cfg.window, seed_arrays=seed, my_id=self.my_id,
+            defer_app_states=Config.get_bool(PC.RECOVERY_LAZY_HYDRATION),
         )
         if rec.arrays is None:
             return
@@ -572,11 +588,19 @@ class PaxosManager:
             self.app_exec_slot[r] = int(exec_np[r])
             self.pending_exec.pop(r, None)
         app_states = meta.get("app_states") or {}
+        # lazy mode: the checkpoint's app states stayed on disk
+        # (rec.view); its NAME DOMAIN still decides precedence exactly as
+        # the eager restore would (checkpoint state wins over a replayed
+        # create's init), the restore itself just happens at hydration
+        ck_domain = (
+            set(rec.view.meta.get("names") or ())
+            if rec.view is not None else set()
+        )
         for name, state_str in app_states.items():
             if name in self.names:
                 self.app.restore(name, state_str)
         for name, init in journal_inits.items():
-            if name not in app_states:
+            if name not in app_states and name not in ck_domain:
                 self.app.restore(name, init)
         # residency: fold pause records (LAST — checkpoint app-state and
         # cursor restoration above must not overwrite the fold).  A name
@@ -585,6 +609,7 @@ class PaxosManager:
         # and a forgotten promise could accept an older-ballot proposal).
         # A name not live stays paused and reactivates from self.paused.
         arrays = None
+        fold_restored: set = set()  # names whose app state the fold set
         for (nm, e), prec in rec.pause_records.items():
             r = self.names.get(nm)
             if r is not None and int(versions[r]) == e:
@@ -615,6 +640,7 @@ class PaxosManager:
                     arrays["app_hash"][r] = int(prec["app_hash"])
                     arrays["n_execd"][r] = int(prec["n_execd"])
                     self.app.restore(nm, prec.get("app_state"))
+                    fold_restored.add(nm)
                     self.app_exec_slot[r] = int(prec["exec"])
                     self.pending_exec.pop(r, None)
                     for rid_s, ent in (prec.get("dedup") or {}).items():
@@ -678,14 +704,119 @@ class PaxosManager:
         for (nm, e), r in self.old_epochs.items():
             tags[r] = _instance_tag(nm, int(e))
         self.state = self.state._replace(tag=jnp.asarray(tags))
-        # synchronous rollforward through the app (initiateRecovery parity:
-        # the reference fully replays before serving); slots whose payloads
-        # are not local stay pending and heal via runtime peer pulls
+        # ---- lazy hydration plan (recovery plane) ---------------------
+        # Checkpoint-domain names not already restored above go COLD:
+        # their rows gate out of admission/execution/reads until the
+        # hydrator restores them.  The recency-ordered hot set (manifest
+        # hints) hydrates NOW — that is the bounded restart-to-serving
+        # window — and the rest restores in the background.
+        hot_hydrated = 0
+        if rec.view is not None:
+            from .recovery.hydration import Hydrator
+
+            hyd = Hydrator(
+                self, rec.view,
+                batch=Config.get_int(PC.RECOVERY_HYDRATION_BATCH),
+            )
+            # the view's engine arrays were already folded into
+            # self.state above; keeping them pinned through the whole
+            # hydration window would carry a duplicate [G,...] host
+            # copy (hundreds of MB at 256k groups) for nothing
+            rec.view.arrays = {}
+            ck_rows = rec.view.meta.get("names") or {}
+            for nm, row in self.names.items():
+                if nm in fold_restored or nm not in ck_domain:
+                    continue
+                self.hydrating_rows.add(row)
+                hyd.add_cold(
+                    nm, rec.view.shard_of_row(int(ck_rows.get(nm, row)))
+                )
+            hot_budget = Config.get_int(PC.RECOVERY_HOT_NAMES)
+            for row in rec.view.meta.get("hot_rows") or ():
+                if hot_budget <= 0 or not hyd.backlog:
+                    break
+                nm = self.row_name.get(int(row))
+                if nm is not None and int(row) in self.hydrating_rows:
+                    hyd.hydrate_name_locked(nm)
+                    hot_budget -= 1
+                    hot_hydrated += 1
+            # hint-less checkpoints (pre-manifest or first generation)
+            # still serve a bounded hot set, in shard order
+            while hot_budget > 0 and hyd.backlog:
+                nm = hyd._pop()
+                if nm is None:
+                    break
+                hyd.hydrate_name_locked(nm)
+                hot_budget -= 1
+                hot_hydrated += 1
+            if hyd.backlog:
+                self.hydrator = hyd
+        # synchronous rollforward through the app (initiateRecovery parity
+        # for everything hydrated; cold rows park their decided slots in
+        # pending_exec until hydration); slots whose payloads are not
+        # local stay pending and heal via runtime peer pulls
         self._drain_pending_exec()
         self._fired_callbacks.clear()  # no clients to answer at recovery
         # first tick gossips a cursor baseline for everything live here
         self._app_exec_dirty.update(self.names.values())
         self._app_exec_dirty.update(self.old_epochs.values())
+        # recovery accounting: the obs counters + `stats` phase surface
+        st = dict(getattr(rec, "stats", None) or {})
+        st["time_to_first_serve_s"] = time.monotonic() - t_recover
+        st["hot_hydrated"] = hot_hydrated
+        st["cold_backlog_at_serve"] = (
+            self.hydrator.backlog if self.hydrator else 0
+        )
+        self._recovery_stats = st
+        mx = self.metrics
+        mx.count("recovery_segments_replayed", st.get("segments", 0))
+        mx.count("recovery_blocks_replayed", st.get("blocks", 0))
+        mx.gauge("recovery_replay_s", st.get("replay_s", 0.0))
+        mx.gauge("recovery_time_to_first_serve_s",
+                 st["time_to_first_serve_s"])
+        mx.gauge("recovery_hydration_backlog", st["cold_backlog_at_serve"])
+        if self.hydrator is not None:
+            self.hydrator.start_background()
+
+    # ------------------------------------------------------------------
+    # recovery-plane surface (phase + stats + read gate)
+    # ------------------------------------------------------------------
+    @property
+    def recovery_phase(self) -> str:
+        """``recovering`` while any name's app state is still on disk;
+        ``serving`` once hydration drained.  The launcher's readiness
+        wait and the ``stats`` admin op read this."""
+        return "recovering" if self.hydrating_rows else "serving"
+
+    def recovery_stats(self) -> Dict[str, Any]:
+        out = dict(self._recovery_stats)
+        out["phase"] = self.recovery_phase
+        out["hydration_backlog"] = (
+            self.hydrator.backlog if self.hydrator else 0
+        )
+        out["hydrated"] = (
+            self.hydrator.n_hydrated if self.hydrator
+            else self._recovery_stats.get("hot_hydrated", 0)
+        )
+        return out
+
+    def local_read_ok(self, name: str) -> bool:
+        """Gate for the uncoordinated local-read fast path: False while
+        the name's app state is un-hydrated (and promotes it to the
+        front of the hydration queue — a request touched it)."""
+        row = self.names.get(name)
+        if row is None or row not in self.hydrating_rows:
+            return True
+        if self.hydrator is not None:
+            self.hydrator.request(name)
+        return False
+
+    def hydrate_all(self, deadline_s: Optional[float] = None) -> bool:
+        """Drain the hydration backlog synchronously (close paths,
+        tests); True when nothing is left cold."""
+        if self.hydrator is None:
+            return not self.hydrating_rows
+        return self.hydrator.drain(deadline_s)
 
     # ------------------------------------------------------------------
     # lifecycle (createPaxosInstance / kill, PaxosManager.java:611,2142)
@@ -717,14 +848,23 @@ class PaxosManager:
         version: int = 0,
         row: Optional[int] = None,
         pending: bool = False,
+        dedup: Optional[Dict] = None,
     ) -> bool:
+        """``dedup`` carries the exactly-once entries snapshotted WITH
+        ``initial_state`` (an epoch-final-state handoff).  They install
+        IF AND ONLY IF this call adopts the state — every install pairs
+        with its restore.  An unpaired install (entries present, state
+        not adopted) skip-executes decisions the app state does not
+        contain and diverges the RSM (chaos seed 662625602)."""
         with self._state_lock:
             return self._create_locked(
-                name, members, initial_state, version, row, pending
+                name, members, initial_state, version, row, pending,
+                dedup=dedup,
             )
 
     def _create_locked(
-        self, name, members, initial_state, version, row, pending=False
+        self, name, members, initial_state, version, row, pending=False,
+        dedup=None,
     ) -> bool:
         if len(members) > self.max_group_size:
             # MAX_GROUP_SIZE ceiling (PaxosConfig.java:532): an oversized
@@ -779,6 +919,9 @@ class PaxosManager:
                 self._stall_since[cur_row] = -1
                 self._stall_slot[cur_row] = -1
                 self._needs_state.discard(cur_row)
+                # epoch upgrade supersedes a cold row's checkpoint state
+                # (the new epoch restores from the stop-time final state)
+                self.hydrating_rows.discard(cur_row)
                 self.app_exec_slot[cur_row] = int(
                     self._np("exec_slot")[cur_row]
                 )
@@ -829,7 +972,84 @@ class PaxosManager:
             )
         if self.my_id in members:
             self.app.restore(name, initial_state)
+            # install paired with the restore just above — and ONLY here:
+            # the idempotent/early returns above adopt no state, so
+            # installing there would be the unpaired-install breach.  A
+            # None state pairs too: its dedup snapshot describes the
+            # history that ENDED in None, exactly what members who lived
+            # through the epoch hold
+            if dedup:
+                self.install_dedup(dedup)
         return True
+
+    def create_paxos_batch(
+        self,
+        names: List[str],
+        members: List[int],
+        initial_states: Optional[Dict[str, Optional[str]]] = None,
+    ) -> int:
+        """Bulk epoch-0 creation: ONE vectorized engine update and ONE
+        journal block pair for N fresh names (the bootstrap/bench path —
+        per-name creates cost a device dispatch each, which at 256k
+        groups is minutes of pure dispatch overhead).  Names already
+        present are skipped; returns how many were created."""
+        if len(members) > self.max_group_size:
+            return 0
+        initial_states = initial_states or {}
+        mask = 0
+        for mem in members:
+            mask |= 1 << mem
+        with self._state_lock:
+            rows, coords, tags, fresh = [], [], [], []
+            try:
+                for name in names:
+                    if name in self.names:
+                        continue
+                    row = self.default_row_for(name)
+                    self.names[name] = row
+                    self.row_name[row] = name
+                    rows.append(row)
+                    coords.append(members[row % len(members)])
+                    tags.append(_instance_tag(name, 0))
+                    fresh.append(name)
+            except RuntimeError:
+                # capacity exhausted mid-batch: the names mapped so far
+                # have NO engine rows / journal entries yet — unwinding
+                # them keeps the table consistent (nothing durable or
+                # on-device happened), then the caller sees the error
+                for name, row in zip(fresh, rows):
+                    del self.names[name]
+                    del self.row_name[row]
+                raise
+            if not fresh:
+                return 0
+            rows_np = np.array(rows, np.int32)
+            self.state = create_groups(
+                self.state, rows_np, np.full(len(rows), mask, np.int32),
+                np.array(coords, np.int32), my_id=self.my_id, version=0,
+                tag=np.array(tags, np.int32),
+            )
+            self.app_exec_slot[rows_np] = 0
+            self._stall_since[rows_np] = -1
+            self._stall_slot[rows_np] = -1
+            self.row_activity[rows_np] = time.time()
+            for arr in self.peer_app_exec.values():
+                arr[rows_np] = 0
+            for row in rows:
+                self._release_row_queue(row)
+                self.pending_exec.pop(row, None)
+            if self.logger:
+                self.logger.log_create(
+                    rows_np, np.full(len(rows), mask, np.int32),
+                    np.zeros(len(rows), np.int32),
+                    np.array(coords, np.int32),
+                    names=fresh,
+                    inits=[initial_states.get(n) for n in fresh],
+                )
+            if self.my_id in members:
+                for name in fresh:
+                    self.app.restore(name, initial_states.get(name))
+            return len(fresh)
 
     def commit_row(self, name: str, epoch: int, row: Optional[int] = None) -> None:
         """The reconfigurator's COMPLETE confirmed (name, epoch) at `row`:
@@ -892,6 +1112,7 @@ class PaxosManager:
             return False
         del self.row_name[row]
         self.pending_rows.discard(row)
+        self.hydrating_rows.discard(row)  # killed cold name: state is moot
         self._payload_blocked.pop(row, None)
         self._stall_since[row] = -1
         self._stall_slot[row] = -1
@@ -970,6 +1191,11 @@ class PaxosManager:
                 return "unknown"
             if int(self._np("stopped")[row]):
                 return "busy"  # stopping group: the delete path owns it
+            if row in self.hydrating_rows:
+                # a pause record snapshots app state — un-hydrated, the
+                # snapshot would capture the pre-restore blank.  Busy is
+                # transient: background hydration clears it
+                return "busy"
             exec_now = int(self._np("exec_slot")[row])
             quiescent = (
                 not self.queues.get(row)
@@ -1352,7 +1578,10 @@ class PaxosManager:
         next epoch's joiners)."""
         with self._state_lock:
             row = self.names.get(name)
-            if row is None:
+            if row is None or row in self.hydrating_rows:
+                # un-hydrated (recovery plane): the app state string does
+                # not reflect ANY executed decision yet — serving it as a
+                # consistent snapshot would hand out the pre-restore blank
                 return False
             return int(self.app_exec_slot[row]) == int(
                 self._np("exec_slot")[row]
@@ -1614,11 +1843,14 @@ class PaxosManager:
         don't count — they cannot drain until the epoch commit lands, and
         counting them would spin the loop through thousands of no-op
         engine ticks for the whole pending window."""
-        if self.pending_exec:
+        hydrating = self.hydrating_rows
+        if self.pending_exec and any(
+            g not in hydrating for g in self.pending_exec
+        ):
             return True
         pending = self.pending_rows
         return any(
-            vids and row not in pending
+            vids and row not in pending and row not in hydrating
             for row, vids in self.queues.items()
         )
 
@@ -1871,6 +2103,15 @@ class PaxosManager:
                 # pre-COMPLETE epoch: hold (don't admit, don't forward) —
                 # nothing may commit on a row the reconfigurator's probe
                 # may still move; the queue drains once epoch_commit lands
+                continue
+            if row in self.hydrating_rows:
+                # un-hydrated name with live traffic: hold admission and
+                # promote it to the front of the hydration queue — the
+                # held requests drain the moment its app state lands
+                if self.hydrator is not None:
+                    name = self.row_name.get(row)
+                    if name is not None:
+                        self.hydrator.request(name)
                 continue
             vids = self._filter_stale_vids(row, vids)
             if not vids:
@@ -2237,6 +2478,16 @@ class PaxosManager:
         returns vids whose payloads are missing (to pull from peers)."""
         missing: List[int] = []
         for g in list(self.pending_exec.keys()):
+            if g in self.hydrating_rows:
+                # recovery plane: executing decided slots against the
+                # not-yet-restored app state would diverge the RSM —
+                # park until the hydrator restores this row, then the
+                # next drain (the hydrator runs one itself) catches up
+                if self.hydrator is not None:
+                    name = self.row_name.get(g)
+                    if name is not None:
+                        self.hydrator.request(name)
+                continue
             if g in self._needs_state:
                 # blank join awaiting a donor's app state (commit-heal
                 # resumed this member before its epoch-final-state fetch
@@ -2487,6 +2738,13 @@ class PaxosManager:
         )
         for g in self._needs_state:
             need[g] = True
+        if self.hydrating_rows:
+            # un-hydrated rows LOOK app-lagged (cursor parked at the
+            # checkpoint frontier by design) but need hydration, not a
+            # donor pull — pulling would adopt peer state that the
+            # hydrator later overwrites with the stale checkpoint copy.
+            # Rows still behind after hydration pull on the next tick
+            need[np.fromiter(self.hydrating_rows, np.int64)] = False
         if not need.any():
             return
         versions = self._np("version")
@@ -2530,6 +2788,10 @@ class PaxosManager:
             if g in self._needs_state:
                 continue  # blank-joined myself: serving my empty state
                 # would "heal" another blank member into blankness
+            if g in self.hydrating_rows:
+                continue  # un-hydrated (recovery plane): my app state is
+                # still the pre-restore blank — donating it would
+                # "heal" the requester into blankness too
             if int(self._np("version")[g]) != int(ent["version"]):
                 continue
             frontier = int(exec_np[g])
@@ -2674,6 +2936,9 @@ class PaxosManager:
             self._stall_since[g] = -1
             self._stall_slot[g] = -1
             self._needs_state.discard(g)
+            # donor state supersedes the checkpoint copy: the hydrator
+            # must NOT later restore the older shard state over it
+            self.hydrating_rows.discard(g)
             if int(ent["stopped"]) and self.on_stop_executed is not None:
                 # the STOP decision will never execute locally (the jump
                 # landed past it) — fire the hook now so the epoch layer
@@ -2693,6 +2958,7 @@ class PaxosManager:
             self._stall_since[g] = -1
             self._stall_slot[g] = -1
             self._needs_state.discard(g)
+            self.hydrating_rows.discard(g)  # donor state supersedes shard
             pend = self.pending_exec.get(g)
             if pend:  # decisions at/past the adopted cursor still execute
                 for slot in [s for s in pend if s < int(ent["exec"])]:
@@ -2712,6 +2978,13 @@ class PaxosManager:
 
     def checkpoint_now(self) -> None:
         if self.logger is None:
+            return
+        if self.hydrating_rows:
+            # a snapshot taken mid-hydration would persist the
+            # pre-restore blank app states of every cold name as a NEWER
+            # generation — catastrophic.  Defer to the next cadence
+            # point; background hydration bounds the wait
+            self.metrics.count("recovery_checkpoint_deferred")
             return
         t_ck = time.monotonic()
         self._checkpoint_now_inner()
@@ -2741,7 +3014,25 @@ class PaxosManager:
         # thread serializes them while the tick keeps running (a loaded
         # snapshot costs ~0.5s of json+npz+fsync; paying it in the tick
         # was the measured latency spike that failed the capacity gate)
+        # recency hints for the recovery plane's hot set: rows ordered by
+        # last activity, newest first (one argsort per checkpoint).  The
+        # next restart hydrates these names before serving
+        act = self.row_activity
+        # hint enough rows to cover the configured hot budget (operators
+        # can raise RECOVERY_HOT_NAMES past the floor).  argpartition,
+        # not a full argsort: this runs in the tick-blocking snapshot
+        # section, and O(G log G) at 1M+ rows is the same in-tick
+        # latency shape the async writer exists to avoid
+        cap = max(16384, Config.get_int(PC.RECOVERY_HOT_NAMES))
+        cap = min(cap, len(act))
+        top = np.argpartition(-act, cap - 1)[:cap] if cap else np.array([], np.int64)
+        top = top[np.argsort(-act[top], kind="stable")]
+        hot_rows = [
+            int(r) for r in top
+            if act[r] > 0 and int(r) in self.row_name
+        ]
         self.logger.checkpoint_async(arrays, app_states, {
+            "hot_rows": hot_rows,
             "names": dict(self.names),
             "pending_rows": sorted(self.pending_rows),
             "needs_state": sorted(self._needs_state),
@@ -2801,5 +3092,7 @@ class PaxosManager:
             return np.asarray(_pack_blob_jit(make_blob(state))), state
 
     def close(self) -> None:
+        if self.hydrator is not None:
+            self.hydrator.stop()
         if self.logger:
             self.logger.close()

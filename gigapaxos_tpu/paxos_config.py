@@ -64,6 +64,28 @@ class PC(FlagEnum):
     # (GP_LOG=server:INFO), so the default deployment pays a level check
     STATS_LOG_PERIOD_S = 10.0
 
+    # ---- recovery plane (new; restart-to-serving SLO) ------------------
+    # checkpoint sharding: >1 splits every snapshot into this many
+    # group-range shards under a content-hashed manifest (torn shard
+    # writes are detected and recovery falls back to the previous
+    # generation's anchor); 1 keeps the legacy single npz+sidecar pair
+    RECOVERY_CHECKPOINT_SHARDS = 4
+    # segmented replay: journal files after the checkpoint anchor are
+    # scanned/CRC-verified/decoded on this many worker threads (the
+    # native gp_journal CRC releases the GIL; GP_NO_NATIVE falls back to
+    # zlib); blocks still APPLY in journal order.  <=1 = sequential
+    RECOVERY_REPLAY_WORKERS = 4
+    # lazy hydration: serve hot names (recency-ordered from the manifest
+    # hints) as soon as the engine arrays + replay land; restore the cold
+    # tail's app states in a background worker.  False = full synchronous
+    # restore before serving (the pre-recovery-plane behavior)
+    RECOVERY_LAZY_HYDRATION = True
+    # names hydrated synchronously before the node starts serving (the
+    # bounded restart-to-serving window); everything else is background
+    RECOVERY_HOT_NAMES = 1024
+    # cold names restored per background batch between lock releases
+    RECOVERY_HYDRATION_BATCH = 256
+
     # ---- pause / residency (ref: PaxosConfig.java:277,291) ------------
     PAUSE_OPTION = True
     DEACTIVATION_PERIOD_S = 60.0

@@ -72,8 +72,16 @@ class WaitEpochFinalState(ProtocolTask):
         if kind != "epoch_final_state":
             return ()
         self.done = True
-        self.ar.coordinator.install_dedup(body.get("dedup"))
-        return self.ar._finish_start_epoch(self.body, body.get("state"))
+        # the dedup snapshot travels WITH the state into the create, and
+        # installs only if the create adopts the state (install/execute
+        # pairing).  Installing it up-front here was the seed-662625602
+        # exactly-once breach: a create that failed (collision/not-ready)
+        # or no-opped (idempotent re-create over a blank join) left the
+        # entries behind, and the member skip-executed decisions its app
+        # state did not contain
+        return self.ar._finish_start_epoch(
+            self.body, body.get("state"), body.get("dedup")
+        )
 
 
 class ActiveReplica:
@@ -328,11 +336,13 @@ class ActiveReplica:
             key, lambda: WaitEpochFinalState(key, self, body)
         )
 
-    def _finish_start_epoch(self, body: Dict, state: Optional[str]):
-        self._ack_start(body, self._create(body, state))
+    def _finish_start_epoch(self, body: Dict, state: Optional[str],
+                            dedup: Optional[Dict] = None):
+        self._ack_start(body, self._create(body, state, dedup))
         return ()
 
-    def _create(self, body: Dict, state: Optional[str]) -> str:
+    def _create(self, body: Dict, state: Optional[str],
+                dedup: Optional[Dict] = None) -> str:
         """Returns "ok", "collision" (row occupied -> RC must probe a new
         row) or "not-ready" (transient local refusal, e.g. the old epoch's
         stop hasn't landed here yet -> RC just retransmits, same row).
@@ -364,6 +374,7 @@ class ActiveReplica:
                     body["name"], int(body["epoch"]), list(body["actives"]),
                     state, row=int(body["row"]),
                     pending=not body.get("committed", False),
+                    dedup=dedup,
                 )
             return "ok" if ok else "not-ready"
         except RuntimeError:

@@ -47,7 +47,11 @@ class AbstractReplicaCoordinator:
         initial_state: Optional[str],
         row: Optional[int] = None,
         pending: bool = False,
+        dedup=None,
     ) -> bool:
+        """``dedup``: exactly-once entries snapshotted WITH
+        ``initial_state`` — installed only if this create adopts the
+        state (install/restore pairing; see PaxosManager)."""
         raise NotImplementedError
 
     def commit_replica_group(
@@ -143,10 +147,11 @@ class AbstractReplicaCoordinator:
         raise NotImplementedError
 
     def dedup_for_name(self, name: str):
-        """Exactly-once entries to ship WITH an app-state handoff."""
-        raise NotImplementedError
-
-    def install_dedup(self, entries) -> None:
+        """Exactly-once entries to ship WITH an app-state handoff.
+        There is deliberately NO bare install counterpart on this SPI:
+        entries install only THROUGH a create that adopts their state
+        (``create_replica_group(dedup=...)``) — an unpaired install was
+        the seed-662625602 exactly-once breach."""
         raise NotImplementedError
 
     def set_stop_callback(self, cb) -> None:
@@ -175,7 +180,7 @@ class PaxosReplicaCoordinator(AbstractReplicaCoordinator):
 
             handled = execute_uncoordinated(
                 self.app, self.manager.names, name, value, request_id,
-                callback,
+                callback, gate=self.manager.local_read_ok,
             )
             if handled is not None:
                 return handled
@@ -195,10 +200,11 @@ class PaxosReplicaCoordinator(AbstractReplicaCoordinator):
         initial_state: Optional[str],
         row: Optional[int] = None,
         pending: bool = False,
+        dedup=None,
     ) -> bool:
         return self.manager.create_paxos_instance(
             name, members, initial_state=initial_state, version=epoch,
-            row=row, pending=pending,
+            row=row, pending=pending, dedup=dedup,
         )
 
     def commit_replica_group(
@@ -271,9 +277,6 @@ class PaxosReplicaCoordinator(AbstractReplicaCoordinator):
 
     def dedup_for_name(self, name: str):
         return self.manager.dedup_for_name(name)
-
-    def install_dedup(self, entries) -> None:
-        self.manager.install_dedup(entries)
 
     def set_stop_callback(self, cb) -> None:
         self.manager.on_stop_executed = cb

@@ -395,7 +395,7 @@ class PaxosServer:
         unknown-name error)."""
         return execute_uncoordinated(
             self.manager.app, self.manager.names, name, value, request_id,
-            cb,
+            cb, gate=self.manager.local_read_ok,
         ) is True
 
     def _on_client_batch(self, reqs, reply) -> None:
@@ -535,6 +535,12 @@ class PaxosServer:
             out = {
                 "op": op, "name": body.get("name"), "ok": True,
                 "tick": self._tick,
+                # recovery plane: `recovering` until the hydration
+                # backlog drains, then `serving` — the launcher's
+                # readiness wait keys on this to tell "up" from
+                # "caught up"
+                "phase": self.manager.recovery_phase,
+                "recovery": self.manager.recovery_stats(),
                 "engine": self.manager.metrics.snapshot(),
                 "profiler": DelayProfiler.get_snapshot(),
                 "profiler_line": DelayProfiler.get_stats(),

@@ -65,6 +65,67 @@ def _file_idx(name: str) -> Optional[int]:
     return None
 
 
+# payloads at least this large CRC-check through the native library when
+# available (the ctypes call releases the GIL, so segmented replay's
+# scanner threads verify concurrently); small blocks stay on zlib, whose
+# call overhead is lower
+_NATIVE_CRC_MIN = 4096
+
+
+def _crc_fn():
+    """(crc(payload) -> int) using gp_journal.so for large payloads when
+    loaded (GP_NO_NATIVE / no compiler => pure zlib)."""
+    from ..native import journal_lib
+
+    lib = journal_lib()
+    if lib is None:
+        return zlib.crc32
+
+    def crc(payload: bytes) -> int:
+        if len(payload) >= _NATIVE_CRC_MIN:
+            return lib.gpj_crc32(payload, len(payload))
+        return zlib.crc32(payload)
+
+    return crc
+
+
+def read_file_blocks(
+    path: str, from_offset: int = 0
+) -> Tuple[List[Tuple[BlockType, bytes, int, int]], bool]:
+    """Read one journal file's valid blocks from ``from_offset``.
+
+    Returns ``([(type, payload, n_rows, end_offset), ...], clean)`` —
+    ``clean`` is False when the file ends in a torn/corrupt block, in
+    which case everything PAST this file is unreachable (single-writer
+    append order) and the caller must stop the whole scan.  This is the
+    per-segment unit of the recovery plane's parallel replay: framing
+    and CRC verification happen here, concurrently across files, while
+    block APPLICATION stays in journal order."""
+    crc_of = _crc_fn()
+    blocks: List[Tuple[BlockType, bytes, int, int]] = []
+    # an unreadable file raises (loud recovery failure) — only torn
+    # CONTENT truncates the scan; mapping open() errors to clean=False
+    # would silently drop every decision from this file onward
+    with open(path, "rb") as f:
+        if from_offset:
+            f.seek(from_offset)
+        while True:
+            hdr = f.read(_HDR.size)
+            if len(hdr) < _HDR.size:
+                # partial header = benign EOF (scan parity: only payload
+                # tears and magic/CRC mismatches stop the WHOLE scan)
+                return blocks, True
+            magic, btype, n_rows, plen, crc = _HDR.unpack(hdr)
+            if magic != MAGIC:
+                return blocks, False
+            payload = f.read(plen)
+            if len(payload) < plen or crc_of(payload) != crc:
+                return blocks, False
+            blocks.append(
+                (BlockType(btype), payload, n_rows, f.tell())
+            )
+
+
 class Journal:
     """Single-writer append-only journal over rotating files in a dir."""
 
@@ -275,21 +336,13 @@ class Journal:
             if idx < from_file:
                 continue
             path = os.path.join(self.dir, _file_name(idx))
-            with open(path, "rb") as f:
-                if idx == from_file and from_offset:
-                    f.seek(from_offset)
-                while True:
-                    pos = f.tell()
-                    hdr = f.read(_HDR.size)
-                    if len(hdr) < _HDR.size:
-                        break
-                    magic, btype, n_rows, plen, crc = _HDR.unpack(hdr)
-                    if magic != MAGIC:
-                        return  # corrupt: stop the whole scan
-                    payload = f.read(plen)
-                    if len(payload) < plen or zlib.crc32(payload) != crc:
-                        return  # torn tail
-                    yield BlockType(btype), payload, n_rows, (idx, pos + _HDR.size + plen)
+            blocks, clean = read_file_blocks(
+                path, from_offset if idx == from_file else 0
+            )
+            for btype, payload, n_rows, end in blocks:
+                yield btype, payload, n_rows, (idx, end)
+            if not clean:
+                return  # torn/corrupt: everything past it is unreachable
 
     @staticmethod
     def columns(payload: bytes, n_rows: int, n_cols: int) -> np.ndarray:

@@ -19,11 +19,12 @@ from __future__ import annotations
 import contextlib
 import json
 import threading
+import time
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
-from .checkpoint import load_checkpoint, save_checkpoint
+from .checkpoint import CheckpointView, load_checkpoint_view, save_checkpoint
 from .journal import BlockType, Journal
 
 NULL = -1
@@ -62,6 +63,12 @@ class RecoveredState:
         self.decisions = decisions or {}
         # vid -> (entry_replica, request_id) journaled alongside payloads
         self.payload_meta: Dict[int, Tuple[int, int]] = {}
+        # the (possibly sharded) checkpoint this recovery loaded, kept
+        # for lazy per-shard app-state hydration; None = no checkpoint
+        # or the caller asked for eager app states
+        self.view: Optional[CheckpointView] = None
+        # replay accounting for the recovery_* metrics / bench surface
+        self.stats: Dict[str, Any] = {}
 
 
 class PaxosLogger:
@@ -86,6 +93,13 @@ class PaxosLogger:
 
         self.gc_every = max(1, Config.get_int(PC.JOURNAL_GC_FREQUENCY))
         self._ckpts_since_gc = 0
+        # recovery plane: checkpoint sharding + segmented-replay width
+        self.ckpt_shards = max(
+            1, Config.get_int(PC.RECOVERY_CHECKPOINT_SHARDS)
+        )
+        self.replay_workers = max(
+            1, Config.get_int(PC.RECOVERY_REPLAY_WORKERS)
+        )
         # async checkpoint writer (newest pending snapshot wins)
         self._ck_lock = threading.Lock()
         self._ck_pending = None
@@ -237,7 +251,8 @@ class PaxosLogger:
         return pos, meta
 
     def _checkpoint_write(self, engine_arrays, meta, pos) -> None:
-        save_checkpoint(self.dir, engine_arrays, meta)
+        save_checkpoint(self.dir, engine_arrays, meta,
+                        n_shards=self.ckpt_shards)
         self.journal.append(
             BlockType.CHECKPOINT,
             json.dumps({"journal_pos": list(pos)}).encode("utf-8"),
@@ -278,20 +293,41 @@ class PaxosLogger:
         window: int,
         seed_arrays: Optional[Dict[str, np.ndarray]] = None,
         my_id: Optional[int] = None,
+        defer_app_states: bool = False,
     ) -> RecoveredState:
         """Load newest snapshot, then roll every later block forward into
         the arrays.  ``seed_arrays`` (a fresh init_state as numpy, from the
         manager) is the base when no checkpoint exists but the journal has
-        blocks; arrays=None means nothing durable at all."""
-        ck = load_checkpoint(self.dir)
-        if ck is None:
+        blocks; arrays=None means nothing durable at all.
+
+        ``defer_app_states=True`` leaves ``meta["app_states"]`` empty and
+        hands the checkpoint back as ``RecoveredState.view`` instead: the
+        caller hydrates app states per shard (the lazy-hydration path —
+        parsing 256k app-state strings up front is most of a cold
+        restart).  Journal files after the anchor scan on
+        ``RECOVERY_REPLAY_WORKERS`` threads; application stays in order."""
+        from ..recovery.replay import scan_segments
+
+        t_recover = time.monotonic()
+        view = load_checkpoint_view(self.dir)
+        if view is None:
             arrays: Optional[Dict[str, np.ndarray]] = None
             meta: Dict[str, Any] = {}
             from_file, from_off = 0, 0
         else:
-            arrays_ro, meta = ck
-            arrays = {k: v.copy() for k, v in arrays_ro.items()}
+            # the view's arrays are freshly materialized (npz load /
+            # concatenate) — safe to roll forward in place, no copy
+            arrays = view.arrays
+            meta = dict(view.meta)
+            meta.pop("app_states_unmapped", None)
+            meta["app_states"] = (
+                {} if defer_app_states else view.all_app_states()
+            )
             from_file, from_off = meta.get("journal_pos", [0, 0])
+        n_blocks = 0
+        files_before = len([
+            i for i in self.journal.file_indices() if i >= from_file
+        ])
         payloads: Dict[int, str] = {}
         names: Dict[str, List[Dict[str, Any]]] = {}
         # chronological pending-row tracking: checkpoint seed, then NAMES
@@ -303,7 +339,10 @@ class PaxosLogger:
         }
         decisions: Dict[int, Dict[int, int]] = {}
         payload_meta: Dict[int, Tuple[int, int]] = {}
-        for btype, payload, n_rows, _pos in self.journal.scan(from_file, from_off):
+        for btype, payload, n_rows, _pos in scan_segments(
+            self.journal, from_file, from_off, workers=self.replay_workers
+        ):
+            n_blocks += 1
             if btype == BlockType.PAUSE:
                 rec = json.loads(payload.decode("utf-8"))
                 key = (str(rec["name"]), int(rec["epoch"]))
@@ -359,6 +398,17 @@ class PaxosLogger:
             arrays, meta, payloads, names, pending, pause_records, decisions
         )
         out.payload_meta = payload_meta
+        if defer_app_states:
+            out.view = view
+        out.stats = {
+            "segments": files_before,
+            "blocks": n_blocks,
+            "replay_s": time.monotonic() - t_recover,
+            "checkpoint_generation": (
+                view.generation if view is not None else None
+            ),
+            "checkpoint_shards": view.n_shards if view is not None else 0,
+        }
         return out
 
     @staticmethod

@@ -99,6 +99,27 @@ def port_up(addr: Tuple[str, int], timeout: float = 0.2) -> bool:
         return False
 
 
+def node_phase(addr: Tuple[str, int], timeout: float = 2.0) -> Optional[str]:
+    """The node's recovery phase (``recovering`` | ``serving``) via a
+    one-shot ``stats`` admin op through the regular client (the same
+    path ``probe.py --attach`` uses), or None when the node is
+    unreachable / mid-boot / TLS-only (callers degrade to liveness).
+    Distinguishes "up" (listening) from "caught up" (hydration done)."""
+    from gigapaxos_tpu.clients import PaxosClientAsync
+
+    try:
+        client = PaxosClientAsync([addr])
+    except Exception:
+        return None
+    try:
+        resp = client.admin_sync(0, {"op": "stats"}, timeout=timeout)
+        return (resp or {}).get("phase")
+    except Exception:
+        return None
+    finally:
+        client.close()
+
+
 def pick(nodes: Dict[str, Tuple[str, int]], wanted: List[str]) -> List[str]:
     if wanted == ["all"] or not wanted:
         return sorted(nodes)
@@ -134,9 +155,16 @@ def do_start(args, nodes: Dict[str, Tuple[str, int]]) -> int:
         pid_file(run_dir, name).write_text(str(proc.pid))
         started.append(name)
         print(f"{name}: started pid {proc.pid} -> {nodes[name]}")
-    # readiness: every started node's listener must accept
+    # readiness: every started node's listener must accept, AND report
+    # phase=serving (recovery hydration done).  "up" != "caught up": a
+    # restarting node accepts connections while its cold tail is still
+    # hydrating — routing a full traffic share at it then would answer
+    # hot names fast and queue everything cold.  A node whose phase
+    # cannot be probed (TLS-only plane, mid-boot) passes on liveness
+    # alone once the listener accepts.
     deadline = time.time() + args.wait_s
     pending = set(started)
+    recovering: Dict[str, str] = {}
     while pending and time.time() < deadline:
         for name in sorted(pending):
             if not pid_alive(read_pid(run_dir, name)):
@@ -145,13 +173,22 @@ def do_start(args, nodes: Dict[str, Tuple[str, int]]) -> int:
                 )[-2000:]
                 print(f"{name}: DIED during startup; log tail:\n{tail}")
                 return 1
-            if port_up(nodes[name]):
-                pending.discard(name)
+            if not port_up(nodes[name]):
+                continue
+            phase = node_phase(nodes[name])
+            if phase == "recovering":
+                recovering[name] = phase
+                continue
+            if name in recovering:
+                print(f"{name}: serving (hydration done)")
+                recovering.pop(name, None)
+            pending.discard(name)
         if pending:
             time.sleep(0.3)
     if pending:
-        print(f"timeout: not listening after {args.wait_s}s: "
-              f"{sorted(pending)}")
+        still = {n: ("recovering" if n in recovering else "not listening")
+                 for n in sorted(pending)}
+        print(f"timeout after {args.wait_s}s: {still}")
         return 1
     if started:
         print(f"up: {sorted(started)}")
@@ -190,6 +227,12 @@ def do_status(args, nodes: Dict[str, Tuple[str, int]]) -> int:
         listening = alive and port_up(nodes[name])
         state = ("up" if listening
                  else "starting" if alive else "down")
+        if listening:
+            # up != caught up: surface the recovery phase so operators
+            # (and the readiness wait) can tell a hydrating node apart
+            phase = node_phase(nodes[name])
+            if phase:
+                state = f"up ({phase})"
         all_up = all_up and listening
         print(f"{name}: {state}"
               + (f" (pid {pid}, {nodes[name][0]}:{nodes[name][1]})"

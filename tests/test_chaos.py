@@ -56,13 +56,55 @@ _SEEDS = (
 
 # exactly-once breach shapes the r5 sweeps caught in the act (timing-
 # sensitive: they fired under a 90-round/30%-loss soak on a loaded box;
-# pinned at that shape so the schedules stay covered)
-_BREACH_SEEDS = [991134624, 881578088, 881205895]
+# pinned at that shape so the schedules stay covered).  662625602: the
+# PR-2 trace-root-caused unpaired-dedup-install breach (a member
+# skip-executed slot 0 on a pre-existing cache entry its app state did
+# not contain) — fixed by pairing every install with its state adoption
+# in create_paxos_instance; pinned here as the trajectory guard, with
+# test_unpaired_dedup_install_regression as the schedule-independent one
+_BREACH_SEEDS = [991134624, 881578088, 881205895, 662625602]
 
 
 @pytest.mark.parametrize("seed", _BREACH_SEEDS)
 def test_chaos_breach_shapes(seed):
     run_soak(seed, rounds=90, loss=0.3)
+
+
+def test_unpaired_dedup_install_regression():
+    """Schedule-independent guard for the seed-662625602 family: dedup
+    entries shipped WITH an epoch-state handoff must install IF AND ONLY
+    IF the create adopts the state.  A failed (collision) or no-op
+    (idempotent re-create) create that leaves the entries behind lets
+    the member skip-execute decisions its app state does not contain."""
+    from gigapaxos_tpu.manager import PaxosManager
+    from gigapaxos_tpu.models import StatefulAdderApp
+    from gigapaxos_tpu.ops.engine import EngineConfig as EC
+
+    m = PaxosManager(
+        0, StatefulAdderApp(),
+        EC(n_groups=4, window=4, req_lanes=2, n_replicas=3),
+    )
+    dedup = {"123": [time.time(), "7", "svc"]}
+    m.create_paxos_instance("other", [0, 1, 2], row=0)
+    # collision: the create fails -> the entries must NOT appear
+    with pytest.raises(RuntimeError):
+        m.create_paxos_instance(
+            "svc", [0, 1, 2], initial_state="5", version=1, row=0,
+            dedup=dedup,
+        )
+    assert 123 not in m.response_cache
+    # adoption: state restored -> the paired entries install
+    assert m.create_paxos_instance(
+        "svc", [0, 1, 2], initial_state="5", version=1, row=1, dedup=dedup
+    )
+    assert m.app.totals.get("svc") == 5
+    assert m.response_cache[123][1] == "7"
+    # idempotent re-create adopts nothing -> fresh entries must NOT ride
+    assert m.create_paxos_instance(
+        "svc", [0, 1, 2], initial_state="5", version=1, row=1,
+        dedup={"456": [time.time(), "9", "svc"]},
+    )
+    assert 456 not in m.response_cache
 
 
 @pytest.mark.parametrize("seed", _SEEDS)
