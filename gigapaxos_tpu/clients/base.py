@@ -14,14 +14,20 @@ from __future__ import annotations
 import asyncio
 import random
 import ssl
+import struct
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..net import hot_codec
 from ..net.codec import encode_json
 from ..net.transport import MAGIC, _HDR
 from ..paxos_config import PC
 from ..utils.config import Config
+
+# the only body shape the binary 'R' frame can carry; anything richer
+# (future fields) falls back to the JSON frame for the whole batch
+_R_BODY_KEYS = frozenset(("name", "value", "request_id", "stop"))
 
 Addr = Tuple[str, int]
 
@@ -65,6 +71,9 @@ class AsyncFrameClient:
         self._agg: Dict[Addr, List[Dict]] = {}
         self._agg_scheduled = False
         self._last_cb_gc = 0.0  # periodic callback-TTL sweep clock
+        # binary hot-path frames ('R' out / 'S' back, net/hot_codec.py):
+        # one fixed-layout scan per frame instead of a JSON round trip
+        self._binary_frames = Config.get_bool(PC.BINARY_CLIENT_FRAMES)
 
     def mint_id(self) -> int:
         with self._lock:
@@ -101,19 +110,51 @@ class AsyncFrameClient:
         if need_schedule:
             self._loop.call_soon_threadsafe(self._flush_agg)
 
+    def send_request_bodies(self, addr: Addr, bodies: List[Dict]) -> None:
+        """Bulk :meth:`send_request_body`: one lock hold and at most one
+        flush schedule for a whole quantum of requests."""
+        with self._lock:
+            self._agg.setdefault(addr, []).extend(bodies)
+            need_schedule = not self._agg_scheduled
+            self._agg_scheduled = True
+        if need_schedule:
+            self._loop.call_soon_threadsafe(self._flush_agg)
+
     def _flush_agg(self) -> None:
         with self._lock:
             bufs, self._agg = self._agg, {}
             self._agg_scheduled = False
         tag = getattr(self, "my_tag", -1)
         for addr, bodies in bufs.items():
-            if len(bodies) == 1:
-                frame = encode_json("client_request", tag, bodies[0])
-            else:
-                frame = encode_json(
-                    "client_request_batch", tag, {"reqs": bodies}
-                )
+            frame = None
+            if self._binary_frames:
+                frame = self._encode_binary(tag, bodies)
+            if frame is None:
+                if len(bodies) == 1:
+                    frame = encode_json("client_request", tag, bodies[0])
+                else:
+                    frame = encode_json(
+                        "client_request_batch", tag, {"reqs": bodies}
+                    )
             self._loop.create_task(self._asend(addr, frame))
+
+    @staticmethod
+    def _encode_binary(tag: int, bodies: List[Dict]) -> Optional[bytes]:
+        """One 'R' frame for the whole batch, or None when any body
+        doesn't fit the fixed layout (the JSON path owes those)."""
+        items = []
+        for b in bodies:
+            rid = b.get("request_id")
+            if rid is None or not _R_BODY_KEYS.issuperset(b):
+                return None
+            items.append((
+                int(rid), b["name"], b.get("value", ""),
+                bool(b.get("stop")),
+            ))
+        try:
+            return hot_codec.encode_request_batch(tag, items)
+        except (ValueError, OverflowError, struct.error):
+            return None  # oversize name/id etc.: JSON handles it
 
     async def _asend(self, addr: Addr, frame: bytes) -> None:
         conn = self._conns.get(addr)

@@ -118,7 +118,18 @@ class PaxosClientAsync(AsyncFrameClient):
         return ok
 
     def _dispatch(self, payload: bytes) -> None:
-        if decode_kind(payload) != "J":
+        kind = decode_kind(payload)
+        if kind == "S":  # binary response batch (hot path)
+            from ..net import hot_codec
+
+            try:
+                _sender, items = hot_codec.decode_response_batch(payload)
+            except ValueError:
+                return
+            for sub in items:
+                self._on_response(sub)
+            return
+        if kind != "J":
             return
         k, _s, body = decode_json(payload)
         if k == "client_response":

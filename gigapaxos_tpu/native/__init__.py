@@ -2,12 +2,14 @@
 fallbacks.
 
 The compute path is JAX/XLA; the runtime around it goes native where the
-reference's equivalents are its own hot paths — here the journal's framed
+reference's equivalents are its own hot paths — the journal's framed
 append (header build + CRC32 + write [+fsync] as one C call, ~10x the
-Python framing cost per block).  The shared object is built on first use
-with the system compiler and cached next to the source; every consumer
-must keep working when no compiler is available (the loader returns None
-and callers fall back to pure Python).
+Python framing cost per block) and the client-plane wire codec
+(``gp_codec.cc``: binary request/response batch frames scanned and packed
+with the GIL released).  Shared objects are built on first use with the
+system compiler and cached next to the source; every consumer must keep
+working when no compiler is available (the loader returns None and
+callers fall back to pure Python — ``GP_NO_NATIVE=1`` forces that path).
 """
 
 from __future__ import annotations
@@ -16,22 +18,20 @@ import ctypes
 import os
 import subprocess
 import threading
-from typing import Optional
+from typing import Dict, Optional, Tuple
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_DIR, "gp_journal.cc")
-_SO = os.path.join(_DIR, "libgp_journal.so")
 
 _lock = threading.Lock()
-_lib: Optional[ctypes.CDLL] = None
-_tried = False
+# name -> (lib or None, tried)
+_libs: Dict[str, Tuple[Optional[ctypes.CDLL], bool]] = {}
 
 
-def _build() -> bool:
+def _build(src: str, so: str) -> bool:
     for cxx in ("g++", "c++", "clang++"):
         try:
             r = subprocess.run(
-                [cxx, "-O2", "-shared", "-fPIC", "-o", _SO, _SRC],
+                [cxx, "-O2", "-shared", "-fPIC", "-o", so, src],
                 capture_output=True, timeout=120,
             )
             if r.returncode == 0:
@@ -41,46 +41,98 @@ def _build() -> bool:
     return False
 
 
-def journal_lib() -> Optional[ctypes.CDLL]:
-    """The native journal library, or None (pure-Python fallback)."""
-    global _lib, _tried
+def _load(name: str, declare) -> Optional[ctypes.CDLL]:
+    """Build-if-stale + load + declare + self-check one native library.
+    ``declare(lib) -> bool`` sets arg/restypes and runs a sanity probe;
+    False rejects the library (fallback to pure Python)."""
     with _lock:
-        if _lib is not None or _tried:
-            return _lib
-        _tried = True
+        ent = _libs.get(name)
+        if ent is not None and ent[1]:
+            return ent[0]
+        _libs[name] = (None, True)
         if os.environ.get("GP_NO_NATIVE"):
             return None
+        src = os.path.join(_DIR, f"{name}.cc")
+        so = os.path.join(_DIR, f"lib{name}.so")
         try:
-            if not os.path.exists(_SO) or (
-                os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+            if not os.path.exists(so) or (
+                os.path.getmtime(so) < os.path.getmtime(src)
             ):
-                if not _build():
+                if not _build(src, so):
                     return None
-            lib = ctypes.CDLL(_SO)
-            lib.gpj_crc32.argtypes = [ctypes.c_char_p, ctypes.c_uint32]
-            lib.gpj_crc32.restype = ctypes.c_uint32
-            lib.gpj_append.argtypes = [
-                ctypes.c_int, ctypes.c_uint8, ctypes.c_uint32,
-                ctypes.c_char_p, ctypes.c_uint32, ctypes.c_int,
-            ]
-            lib.gpj_append.restype = ctypes.c_int64
-            lib.gpj_append_batch.argtypes = [
-                ctypes.c_int,
-                ctypes.POINTER(ctypes.c_uint8),
-                ctypes.POINTER(ctypes.c_uint32),
-                ctypes.POINTER(ctypes.c_char_p),
-                ctypes.POINTER(ctypes.c_uint32),
-                ctypes.c_uint32, ctypes.c_int,
-            ]
-            lib.gpj_append_batch.restype = ctypes.c_int64
-            # self-check: CRC must match zlib exactly or journals written
-            # natively would be unreadable by the Python scanner
-            import zlib
-
-            probe = b"gp-journal-crc-selfcheck"
-            if lib.gpj_crc32(probe, len(probe)) != zlib.crc32(probe):
+            lib = ctypes.CDLL(so)
+            if not declare(lib):
                 return None
-            _lib = lib
+            _libs[name] = (lib, True)
         except OSError:
             return None
-        return _lib
+        return lib
+
+
+def _declare_journal(lib: ctypes.CDLL) -> bool:
+    lib.gpj_crc32.argtypes = [ctypes.c_char_p, ctypes.c_uint32]
+    lib.gpj_crc32.restype = ctypes.c_uint32
+    lib.gpj_append.argtypes = [
+        ctypes.c_int, ctypes.c_uint8, ctypes.c_uint32,
+        ctypes.c_char_p, ctypes.c_uint32, ctypes.c_int,
+    ]
+    lib.gpj_append.restype = ctypes.c_int64
+    lib.gpj_append_batch.argtypes = [
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_uint32),
+        ctypes.POINTER(ctypes.c_char_p),
+        ctypes.POINTER(ctypes.c_uint32),
+        ctypes.c_uint32, ctypes.c_int,
+    ]
+    lib.gpj_append_batch.restype = ctypes.c_int64
+    # self-check: CRC must match zlib exactly or journals written
+    # natively would be unreadable by the Python scanner
+    import zlib
+
+    probe = b"gp-journal-crc-selfcheck"
+    return lib.gpj_crc32(probe, len(probe)) == zlib.crc32(probe)
+
+
+def _declare_codec(lib: ctypes.CDLL) -> bool:
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    pp = ctypes.POINTER(ctypes.c_char_p)
+    lib.gpc_req_index.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_uint32,
+    ]
+    lib.gpc_req_index.restype = ctypes.c_int64
+    lib.gpc_resp_index.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_uint32,
+    ]
+    lib.gpc_resp_index.restype = ctypes.c_int64
+    lib.gpc_pack_req.argtypes = [
+        u8p, ctypes.c_uint64, ctypes.c_int32, ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_uint64), u8p,
+        pp, ctypes.POINTER(ctypes.c_uint16),
+        pp, ctypes.POINTER(ctypes.c_uint32),
+    ]
+    lib.gpc_pack_req.restype = ctypes.c_int64
+    lib.gpc_pack_resp.argtypes = [
+        u8p, ctypes.c_uint64, ctypes.c_int32, ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_uint64), u8p, u8p,
+        pp, ctypes.POINTER(ctypes.c_uint16),
+        pp, ctypes.POINTER(ctypes.c_uint32),
+    ]
+    lib.gpc_pack_resp.restype = ctypes.c_int64
+    # self-check: an empty batch must index back to zero items — a
+    # mis-built library must never reach the wire
+    hdr = b"R" + (0).to_bytes(4, "little") + (0).to_bytes(4, "little")
+    out = (ctypes.c_int64 * 6)()
+    return lib.gpc_req_index(hdr, len(hdr), out, 1) == 0
+
+
+def journal_lib() -> Optional[ctypes.CDLL]:
+    """The native journal library, or None (pure-Python fallback)."""
+    return _load("gp_journal", _declare_journal)
+
+
+def codec_lib() -> Optional[ctypes.CDLL]:
+    """The native wire-codec library, or None (pure-Python fallback)."""
+    return _load("gp_codec", _declare_codec)

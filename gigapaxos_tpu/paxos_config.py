@@ -35,6 +35,32 @@ class PC(FlagEnum):
     BATCH_SLEEP_MS = 0.2                 # adaptive batcher base sleep
     MIN_PP_BATCH_SIZE = 3
 
+    # ---- serving pipeline (host-path ceiling: dispatch/codec/sharding) -
+    # double-buffered dispatch: the jitted engine step for batch N runs
+    # asynchronously (dispatch-and-go) while transport threads frame,
+    # decode, and admit batch N+1 — the manager lock is NOT held across
+    # the device sync, so ingress/codec work overlaps the ~1ms step
+    # instead of following it.  False = serial tick (lock held across the
+    # whole step), the pre-pipeline behavior; the two are step-for-step
+    # state-identical (tests/test_pipeline.py pins it)
+    PIPELINE_DISPATCH = True
+    # binary client hot-path frames ('R' request / 'S' response batches,
+    # net/hot_codec.py): replaces per-request JSON on the client plane;
+    # decode/encode run in the native layer when available (GP_NO_NATIVE
+    # or a missing toolchain falls back to a byte-identical pure-Python
+    # codec).  False = JSON client frames everywhere (legacy)
+    BINARY_CLIENT_FRAMES = True
+    # worker sharding: >1 splits this node's groups across that many
+    # worker PROCESSES by name hash (group-range shards, the checkpoint-
+    # shard scheme applied to serving) — each worker owns its own engine
+    # arrays and journal and exchanges compact blobs with the SAME worker
+    # index on peer replicas; the parent process only accepts and routes.
+    # 1 (default) = today's single-process node, exactly
+    SERVING_WORKERS = 1
+    # worker w of a node listens at node_port + this + w (mesh), with the
+    # usual CLIENT_PORT_OFFSET split layered on top inside the worker
+    SERVING_WORKER_PORT_OFFSET = 500
+
     # ---- durability (ref: PaxosConfig.java:240,314,334,410) -----------
     ENABLE_JOURNALING = True
     SYNC_JOURNAL = False                 # fsync every journal batch

@@ -357,11 +357,21 @@ class ReconfigurableNode:
                 f"{name!r} appears in neither active.* nor reconfigurator.*"
             )
         if ar_id is not None:
-            self.servers.append(ActiveReplicaServer(
-                ar_id, ar_nodes, rc_nodes, make_app(), ar_cfg,
-                log_dir=(f"{log_dir}/ar{ar_id}" if log_dir else None),
-                **server_kw,
-            ))
+            n_workers = Config.get_int(PC.SERVING_WORKERS)
+            if n_workers > 1:
+                # sharded serving: this process becomes the accept/route
+                # parent; worker PROCESSES own the engine/journal per
+                # name shard (gigapaxos_tpu/serving/).  The RC role (if
+                # this node holds one) stays unsharded below.
+                from .serving.router import ShardedActiveNode
+
+                self.servers.append(ShardedActiveNode(name, n_workers))
+            else:
+                self.servers.append(ActiveReplicaServer(
+                    ar_id, ar_nodes, rc_nodes, make_app(), ar_cfg,
+                    log_dir=(f"{log_dir}/ar{ar_id}" if log_dir else None),
+                    **server_kw,
+                ))
         if rc_id is not None:
             self.servers.append(ReconfiguratorServer(
                 rc_id, ar_nodes, rc_nodes, rc_cfg, ar_cfg,

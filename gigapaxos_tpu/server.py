@@ -20,12 +20,14 @@ from __future__ import annotations
 import logging
 import threading
 import time
+import weakref
 from typing import Callable, Dict, Optional, Tuple
 
 import numpy as np
 
 from .failure_detection import FailureDetector
 from .manager import PaxosManager, execute_uncoordinated
+from .net import hot_codec
 from .net.codec import (
     decode_blob_vec,
     decode_json,
@@ -134,11 +136,24 @@ class PaxosServer:
         self._last_publish = 0.0
         self.IDLE_REPUBLISH_S = 0.5
         # per-connection client-response buffer: responses fired during a
-        # tick coalesce into ONE client_response_batch frame per
-        # connection (the PaxosPacketBatcher idea applied at the client
-        # boundary — on a small host, per-response frames dominate CPU)
+        # tick coalesce into ONE frame per connection (the
+        # PaxosPacketBatcher idea applied at the client boundary — on a
+        # small host, per-response frames dominate CPU).  Flushing
+        # happens ONCE per loop cycle (tick or idle), across the
+        # pipeline boundary — ingress handlers only buffer, so one
+        # syscall carries every completion a cycle produced for a peer
         self._resp_lock = threading.Lock()
-        self._resp_buf: Dict[int, Tuple[Callable, list]] = {}
+        self._resp_buf: Dict[Tuple[int, bool], Tuple[Callable, list, bool]] = {}
+        # connections that spoke the binary 'R' request frame get binary
+        # 'S' response frames; weak so short-lived client connections
+        # don't accumulate (the reply closure dies with its connection)
+        self._binary_replies: "weakref.WeakSet" = weakref.WeakSet()
+        # serving pipeline: double-buffered dispatch (the engine step for
+        # batch N computes while this thread frames/publishes tick N-1's
+        # outputs and transport threads admit batch N+1)
+        self._pipeline = Config.get_bool(PC.PIPELINE_DISPATCH)
+        self._pub: Optional[Dict] = None  # pending publish of last tick
+        self._self_msgs: list = []  # self-destined forwards, post-overlap
         # large-message streaming (LargeCheckpointer analog,
         # LargeCheckpointer.java:43 / CheckpointServer:1237): a control
         # frame above MAX_LOG_MESSAGE_SIZE is split into paced chunk
@@ -191,7 +206,11 @@ class PaxosServer:
     def _on_client_plane_message(
         self, payload: bytes, peer: Tuple[str, int], reply
     ) -> None:
-        if decode_kind(payload) != "J":
+        kind = decode_kind(payload)
+        if kind == "R":  # binary request batch (hot path)
+            self._on_binary_requests(payload, reply)
+            return
+        if kind != "J":
             return  # packed consensus blobs never come from clients
         try:
             k, sender, body = decode_json(payload)
@@ -203,9 +222,30 @@ class PaxosServer:
         if k != "fd_ping":
             self._kick.set()
 
+    def _on_binary_requests(self, payload: bytes, reply) -> None:
+        """Ingress for the binary 'R' client frame (net/hot_codec.py):
+        decode (native, GIL-released when available) and admit as ONE
+        batched manager call.  The connection is marked binary so its
+        responses ride 'S' frames."""
+        try:
+            _sender, items = hot_codec.decode_request_batch(payload)
+        except ValueError:
+            if "R" not in self._schema_skew_warned:
+                self._schema_skew_warned.add("R")
+                self.log.warning(
+                    "dropping malformed binary request frame (codec skew?)"
+                )
+            return
+        self._binary_replies.add(reply)
+        self._on_client_items(items, reply, binary=True)
+        self._kick.set()
+
     # ---- message ingress (demultiplexer analog) ------------------------
     def _on_message(self, payload: bytes, peer: Tuple[str, int], reply) -> None:
         kind = decode_kind(payload)
+        if kind == "R":  # binary client request batch (hot path)
+            self._on_binary_requests(payload, reply)
+            return
         if kind not in ("D", "J"):
             # frame from a DIFFERENT schema (pre-tag "B", pre-compact "C",
             # or anything newer): parsing a fixed-layout blob misaligned
@@ -258,6 +298,10 @@ class PaxosServer:
         elif k == "fd_ping":
             pass  # hearing it is the point (any traffic counts as alive)
         elif k == "client_request":
+            # singleton frames only arrive at low rate (the client
+            # aggregates under load), so the immediate flush is cheap
+            # and keeps shed/cached/local-read answers synchronous; the
+            # BATCH paths below buffer and flush once per loop cycle
             self._on_client_request(body, reply)
             self._flush_responses()
         elif k == "client_request_batch":
@@ -266,7 +310,6 @@ class PaxosServer:
             # RequestPacket.java:189-246) — proposed as ONE batched
             # manager call, not per sub-request
             self._on_client_batch(body.get("reqs", ()), reply)
-            self._flush_responses()
         elif k == "admin":
             self._on_admin(body, reply)
         elif k == "echo":
@@ -345,23 +388,30 @@ class PaxosServer:
             )
             self._on_message(frame, ("chunk", sender), reply)
 
-    def _buffer_response(self, reply, item: Dict) -> None:
+    def _buffer_response(self, reply, item: Dict, binary: bool = False) -> None:
         with self._resp_lock:
-            ent = self._resp_buf.get(id(reply))
+            key = (id(reply), binary)
+            ent = self._resp_buf.get(key)
             if ent is None:
-                self._resp_buf[id(reply)] = (reply, [item])
+                self._resp_buf[key] = (reply, [item], binary)
             else:
                 ent[1].append(item)
 
     def _flush_responses(self) -> None:
-        """Ship buffered client responses, one frame per connection."""
+        """Ship buffered client responses, one frame per connection per
+        cycle — binary 'S' frames for connections that spoke 'R', JSON
+        otherwise.  Ingress handlers only buffer; this runs once per
+        loop cycle (across the pipeline boundary, overlapping the device
+        step), so one syscall carries all of a peer's completions."""
         with self._resp_lock:
             if not self._resp_buf:
                 return
             bufs, self._resp_buf = self._resp_buf, {}
         t0 = time.monotonic()
         tr = self.tracer
-        for reply, items in bufs.values():
+        mx = self.manager.metrics
+        n_items = 0
+        for reply, items, binary in bufs.values():
             if tr.enabled:
                 for item in items:
                     tr.note(
@@ -369,12 +419,22 @@ class PaxosServer:
                         name=item.get("name"), node=self.my_id,
                         error=item.get("error"),
                     )
-            if len(items) == 1:
+            n_items += len(items)
+            mx.observe("flush_batch_size", len(items),
+                       bounds=(1, 2, 4, 8, 16, 32, 64, 128, 256, 1024))
+            if binary and all(
+                hot_codec.encodable_response(i) for i in items
+            ):
+                reply(hot_codec.encode_response_batch(self.my_id, items))
+            elif len(items) == 1:
                 reply(encode_json("client_response", self.my_id, items[0]))
             else:
                 reply(encode_json(
                     "client_response_batch", self.my_id, {"resps": items}
                 ))
+        if n_items:
+            mx.count("responses_flushed", n_items)
+            mx.count("response_frames_sent", len(bufs))
         DelayProfiler.update_count("t_flush", time.monotonic() - t0)
 
     def _on_client_request(self, body: Dict, reply) -> None:
@@ -399,21 +459,35 @@ class PaxosServer:
         ) is True
 
     def _on_client_batch(self, reqs, reply) -> None:
-        """Batched-frame ingress: one propose_batch call for the whole
-        frame (stops, local reads, and overload shedding peel off to
-        their own paths; everything else amortizes the lock/clock per
-        frame)."""
+        """JSON batched-frame ingress: normalize to item tuples and take
+        the shared path."""
+        self._on_client_items(
+            [
+                (int(sub["request_id"]), sub["name"],
+                 sub.get("value", ""), bool(sub.get("stop")))
+                for sub in reqs
+            ],
+            reply, binary=False,
+        )
+
+    def _on_client_items(self, reqs, reply, binary: bool = False) -> None:
+        """Batched ingress (both wire formats): one propose_batch call
+        for the whole frame (stops, local reads, and overload shedding
+        peel off to their own paths; everything else amortizes the
+        lock/clock per frame).  ``reqs``: [(request_id, name, value,
+        stop)]."""
         t0 = time.monotonic()
         m = self.manager
         tr = self.tracer
         overloaded = m.overloaded()
         items = []
-        for sub in reqs:
-            if sub.get("stop"):
-                self._on_client_request_inner(sub, reply)
+        for request_id, name, value, stop in reqs:
+            if stop:
+                self._on_client_request_inner({
+                    "request_id": request_id, "name": name,
+                    "value": value, "stop": True,
+                }, reply)
                 continue
-            request_id = int(sub["request_id"])
-            name = sub["name"]
             if tr.enabled:
                 tr.note(request_id, "recv", name=name, node=self.my_id,
                         batch=True)
@@ -421,19 +495,17 @@ class PaxosServer:
             def cb(rid, response, _name=name):
                 self._buffer_response(reply, {
                     "request_id": rid, "response": response, "name": _name,
-                })
+                }, binary)
 
-            if self._maybe_local_read(
-                name, sub.get("value", ""), request_id, cb
-            ):
+            if self._maybe_local_read(name, value, request_id, cb):
                 continue
             if overloaded and request_id not in m.response_cache:
                 self._buffer_response(reply, {
                     "request_id": request_id, "response": None,
                     "name": name, "error": "overload",
-                })
+                }, binary)
                 continue
-            items.append((name, sub.get("value", ""), request_id, cb))
+            items.append((name, value, request_id, cb))
         if items:
             results = m.propose_batch(items)
             for (name, _v, _r, _cb), (rid, outcome, _resp) in zip(
@@ -443,14 +515,14 @@ class PaxosServer:
                     self._buffer_response(reply, {
                         "request_id": rid, "response": None,
                         "name": name, "error": "unknown_name",
-                    })
+                    }, binary)
                 elif outcome == "exhausted":
                     # vid counter space ran out for THIS item; cached and
-                    # in-flight items in the same frame were still served
+                    # in-flight items in the same frame still answer
                     self._buffer_response(reply, {
                         "request_id": rid, "response": None,
                         "name": name, "error": "exhausted",
-                    })
+                    }, binary)
         DelayProfiler.update_count("t_ingress", time.monotonic() - t0)
 
     def _on_client_request_inner(self, body: Dict, reply) -> None:
@@ -541,6 +613,15 @@ class PaxosServer:
                 # "caught up"
                 "phase": self.manager.recovery_phase,
                 "recovery": self.manager.recovery_stats(),
+                # serving-path configuration: which codec implementation
+                # is LIVE (a missing toolchain silently regressing to the
+                # Python path must be visible here, not discovered in a
+                # perf run) and whether dispatch is pipelined
+                "serving": {
+                    "pipeline_dispatch": self._pipeline,
+                    "codec": hot_codec.status(),
+                    "serving_workers": Config.get_int(PC.SERVING_WORKERS),
+                },
                 "engine": self.manager.metrics.snapshot(),
                 "profiler": DelayProfiler.get_snapshot(),
                 "profiler_line": DelayProfiler.get_stats(),
@@ -572,13 +653,24 @@ class PaxosServer:
                 self.log.exception("tick loop error (loop continues)")
             dt = time.perf_counter() - t0
             interval = self.tick_interval
-            if self._batching and self.manager.has_backlog():
+            backlog = self._batching and self.manager.has_backlog()
+            if backlog:
                 interval = max(
                     self._batch_sleep_s, self.manager.last_engine_step_s
                 )
             sleep = interval - dt
             if sleep > 0:
-                self._kick.wait(sleep)
+                if backlog:
+                    # batch aging is KICK-PROOF under backlog: a kick per
+                    # arriving frame would collapse the window back to
+                    # continuous ticking, and each tick costs a full
+                    # engine dispatch no matter how few requests it
+                    # carries — under load, fewer/fatter ticks IS the
+                    # capacity (each consensus leg pays +window latency,
+                    # well inside the budget)
+                    time.sleep(sleep)
+                else:
+                    self._kick.wait(sleep)
             self._kick.clear()
 
     def _should_tick(self) -> bool:
@@ -601,6 +693,8 @@ class PaxosServer:
         """Host housekeeping between engine ticks: FD pings, layered
         protocol-task timers, callback GC.  Runs at the loop cadence so
         liveness machinery never depends on consensus traffic."""
+        self._publish_pending()  # a staged tick must never strand idle
+        self._drain_self_msgs()
         self._maybe_ping()
         self.manager.outstanding.gc()
         self._layer_tick()
@@ -648,9 +742,50 @@ class PaxosServer:
             self.manager._np("member_mask"),
             R,
         )
-        blob_vec, blob_state, delta = self.manager.tick_host(
-            gathered, heard, want
-        )
+        m = self.manager
+        if self._pipeline:
+            # double-buffered dispatch: fire step N and, while the device
+            # computes it, do tick N-1's host-side codec/publish work
+            # (blob frame encode, payload delta, forwards, response
+            # flush).  Transport threads admit batch N+1 throughout —
+            # the manager lock is free for the whole overlap window.
+            # NOTHING in the overlap window may call a manager op that
+            # waits on step completion (same thread completes the step).
+            pend = m.step_dispatch(gathered, heard, want)
+            t_overlap = time.monotonic()
+            self._publish_pending()
+            self._flush_responses()
+            overlap_s = time.monotonic() - t_overlap
+            blob_vec, blob_state, delta = m.step_complete(pend)
+            mx = m.metrics
+            mx.observe("pipeline_overlap_s", overlap_s)
+            step_s = m.last_engine_step_s
+            mx.gauge(
+                "pipeline_overlap_ratio",
+                min(1.0, overlap_s / step_s) if step_s > 0 else 0.0,
+            )
+        else:
+            blob_vec, blob_state, delta = m.tick_host(gathered, heard, want)
+        self._finish_tick(blob_vec, blob_state, delta)
+        self._drain_self_msgs()
+        if not self._pipeline or not m.has_backlog():
+            # serial mode publishes its own tick immediately (the
+            # pre-pipeline behavior, exactly); pipelined mode does too
+            # when the loop is about to go idle — otherwise this tick's
+            # frames ship in the NEXT dispatch's overlap window, which
+            # under backlog begins immediately
+            self._publish_pending()
+
+        t_layer = time.monotonic()
+        self._maybe_ping()
+        self._layer_tick()
+        DelayProfiler.update_count("t_layer", time.monotonic() - t_layer)
+        self._flush_responses()  # callbacks fired by this tick's execution
+
+    def _finish_tick(self, blob_vec, blob_state, delta) -> None:
+        """Post-step bookkeeping shared by both modes: stage this tick's
+        outbound frames (blob / payload delta / forwards) for
+        :meth:`_publish_pending`."""
         self._my_blob_vec = blob_vec
         self._my_blob_state = blob_state
         self._tick += 1
@@ -664,39 +799,69 @@ class PaxosServer:
             DelayProfiler.update_count("n_ticks_noprog")
             if self._in_flight:
                 DelayProfiler.update_count("n_ticks_inflight_noprog")
-
-        # publish: blob to every peer (the all_gather stand-in).  Gated:
-        # publishing from a tick that neither progressed nor has work in
-        # flight would re-trigger peers' blob-driven ticks and the
-        # cluster would ping-pong blobs forever at engine speed (idle
+        # publish gating decided NOW (at the tick that produced the
+        # frames): publishing from a tick that neither progressed nor has
+        # work in flight would re-trigger peers' blob-driven ticks and
+        # the cluster would ping-pong blobs forever at engine speed (idle
         # must converge to silence; the periodic republish in
         # _should_tick keeps stragglers healing).  In-flight republish
         # doubles as the accept-retransmit poke (pokeLocalCoordinator
-        # analog, PaxosInstanceStateMachine.java:2140).
-        # the periodic fallback keys on time since the last PUBLISH, not
+        # analog).  The fallback keys on time since the last PUBLISH, not
         # the last tick: a node ticking continuously without progress
-        # (e.g. consuming a straggler's blobs) would otherwise never
-        # republish and the straggler could not heal from it
-        peers = [r for r in self.node_config.get_node_ids() if r != self.my_id]
-        t_pub = time.monotonic()
-        if progressed or self._in_flight or (
+        # would otherwise never republish and stragglers could not heal
+        publish_blob = progressed or self._in_flight or (
             time.monotonic() - self._last_publish > self.IDLE_REPUBLISH_S
-        ):
+        )
+        self._pub = {
+            "blob_vec": blob_vec if publish_blob else None,
+            "tick": self._tick,
+            "delta": delta if (
+                delta["arena"] or delta.get("app_exec")
+            ) else None,
+            "fwd": m.drain_forward_out(),
+        }
+
+    def _drain_self_msgs(self) -> None:
+        """Deliver self-destined forwards (rare) OUTSIDE the overlap
+        window: on_host_message can replace engine state (state_reply),
+        which must wait for step completion — waiting in the overlap
+        window would deadlock the tick thread on its own step."""
+        if not self._self_msgs:
+            return
+        msgs, self._self_msgs = self._self_msgs, []
+        for k, body in msgs:
+            self.manager.on_host_message(k, body)
+
+    def _publish_pending(self) -> None:
+        """Ship the staged tick outputs (blob to every peer — the
+        all_gather stand-in — plus the payload-delta frame and queued
+        forwards).  In pipelined mode this runs inside the NEXT tick's
+        overlap window, so the frame encode + syscalls overlap the
+        device step instead of following it."""
+        pub, self._pub = self._pub, None
+        if pub is None:
+            return
+        peers = [r for r in self.node_config.get_node_ids()
+                 if r != self.my_id]
+        m = self.manager
+        t_pub = time.monotonic()
+        if pub["blob_vec"] is not None:
             self._last_publish = time.monotonic()
-            blob_frame = encode_blob_vec(self.my_id, self._tick, blob_vec)
+            blob_frame = encode_blob_vec(
+                self.my_id, pub["tick"], pub["blob_vec"]
+            )
             mx = m.metrics
             mx.gauge("blob_frame_bytes", len(blob_frame))
             mx.count("blob_bytes_sent", len(blob_frame) * len(peers))
             mx.count("blob_frames_sent", len(peers))
             for r in peers:
                 self.transport.send_to_id(r, blob_frame)
-        if delta["arena"] or delta.get("app_exec"):
-            frame = encode_json("payloads", self.my_id, delta)
+        if pub["delta"] is not None:
+            frame = encode_json("payloads", self.my_id, pub["delta"])
             for r in peers:
                 self.transport.send_to_id(r, frame)
         DelayProfiler.update_count("t_publish", time.monotonic() - t_pub)
-        fwd = self.manager.drain_forward_out()
-        for dst, k, body in fwd:
+        for dst, k, body in pub["fwd"]:
             frame = encode_json(k, self.my_id, body)
             # send_frame_to_id streams oversize frames (a multi-MB
             # state_reply must not monopolize the link)
@@ -704,15 +869,11 @@ class PaxosServer:
                 for r in peers:
                     self.send_frame_to_id(r, frame)
             elif dst == self.my_id:
-                self.manager.on_host_message(k, body)
+                # deferred: a self-destined host message may replace
+                # engine state and must not run in the overlap window
+                self._self_msgs.append((k, body))
             else:
                 self.send_frame_to_id(dst, frame)
-
-        t_layer = time.monotonic()
-        self._maybe_ping()
-        self._layer_tick()
-        DelayProfiler.update_count("t_layer", time.monotonic() - t_layer)
-        self._flush_responses()  # callbacks fired by this tick's execution
 
     def _maybe_stats_line(self) -> None:
         """Periodic INFO stats line (engine counters + DelayProfiler) —

@@ -118,6 +118,172 @@ def probe_exactly_once(c: ReconfigurableCluster, names) -> None:
                 )
 
 
+
+
+def settle_and_audit(c: ReconfigurableCluster, names, step,
+                     settle_budget_s: float) -> int:
+    """Lossless settle + the strict end-state audit shared by every soak
+    flavor (single-node and worker-sharded): records settle READY/PAUSED,
+    RC agreement, deletes gone, READY rows aligned, RSM convergence, and
+    the exactly-once (exec_slot, n_execd, app_hash) triple.  Raises
+    :class:`SoakDivergence`; returns settle iterations."""
+    # lossless settle, deadline-bound (cold jax compiles and rare
+    # time-gated retransmits burn wall time, not steps)
+    c.msg_filter = None
+    deadline = time.time() + settle_budget_s
+    settled, settle_iters = False, 0
+    while not settled:
+        if time.time() > deadline:
+            break
+        for _ in range(8):
+            step()
+        c.drain_client()
+        settle_iters += 1
+        recs = {
+            nm: c.reconfigurators[0].rc_app.get_record(nm)
+            for nm in names
+        }
+        settled = all(
+            r is None or r.deleted
+            or r.state in (RCState.READY, RCState.PAUSED)
+            for r in recs.values()
+        )
+    if not settled:
+        # the WAIT_* liveness-wedge family lands HERE, so this message
+        # must carry the forensics: for each unsettled name, the full
+        # per-member diag including request timelines and the RCs'
+        # epoch-op timeline (which round is stalled, who never acked)
+        stuck = {
+            nm: r for nm, r in recs.items()
+            if r is not None and not r.deleted
+            and r.state not in (RCState.READY, RCState.PAUSED)
+        }
+        raise SoakDivergence(
+            "records did not settle",
+            {
+                "records": {
+                    nm: (r.to_json() if r else None)
+                    for nm, r in recs.items()
+                },
+                "unsettled": {
+                    nm: _name_diag(
+                        c, nm,
+                        sorted(set(r.actives) | set(r.new_actives or []))
+                    )
+                    for nm, r in stuck.items()
+                },
+            },
+        )
+
+    # record agreement across RCs
+    for nm in names:
+        views = [rc.rc_app.get_record(nm) for rc in c.reconfigurators]
+        datas = [None if v is None else v.to_json() for v in views]
+        if not all(d == datas[0] for d in datas):
+            raise SoakDivergence("RC record disagreement",
+                                 {"name": nm, "views": datas})
+
+    for nm, rec in recs.items():
+        if rec is None or rec.deleted:
+            # poll: a straggler that missed the drop (it could not
+            # ack while its stop was un-executed) heals through the
+            # audit-cadence redrop — give that machinery a window.
+            # Deadline-bound like the READY align loop below: the
+            # post-budget redrops fire at most once per audit period
+            # (wall-timer-gated), so a step-count cap alone can burn
+            # through on a fast box before the timers the heal needs
+            # have fired
+            drop_deadline = time.time() + 6 * max(
+                rc.ready_audit_period_s for rc in c.reconfigurators
+            )
+            while time.time() < drop_deadline:
+                if all(m.names.get(nm) is None for m in c.ars.managers):
+                    break
+                step()
+            for m in c.ars.managers:
+                if m.names.get(nm) is not None:
+                    raise SoakDivergence(
+                        "name lingers post-delete",
+                        {"name": nm, "member": m.my_id},
+                    )
+            continue
+        if rec.state is RCState.PAUSED:
+            held = [m for m in c.ars.managers
+                    if (nm, rec.epoch) in m.paused]
+            if not held:
+                raise SoakDivergence(
+                    "paused with no pause records anywhere", {"name": nm}
+                )
+            continue
+        # READY: actives host the name at ONE aligned row and agree.
+        # Re-read each poll: the deactivation sweep can pause a name
+        # mid-poll; commit-round re-drives heal missed starts.
+        rows: set = set()
+        # deadline-bound like the settle loop: the audit-cadence
+        # heals (READY audit re-running the commit round) are
+        # wall-timer-gated, so an iteration cap alone can expire
+        # before the timers their heals need have fired
+        align_deadline = time.time() + 90
+        while True:
+            rec = c.reconfigurators[0].rc_app.get_record(nm)
+            if rec is None or rec.deleted or \
+                    rec.state is not RCState.READY:
+                break
+            rows = {c.ars.managers[a].names.get(nm) for a in rec.actives}
+            if rows == {rec.row} or time.time() > align_deadline:
+                break
+            step()
+        if rec is None or rec.deleted or rec.state is not RCState.READY:
+            continue
+        if rows != {rec.row}:
+            raise SoakDivergence(
+                "READY actives not aligned at record row",
+                {"name": nm, "want_row": rec.row, "rows": sorted(
+                    (a, c.ars.managers[a].names.get(nm))
+                    for a in rec.actives),
+                 # which start/commit round stranded the outlier —
+                 # the 20260803 re-probe hit this shape blind
+                 "members": _name_diag(c, nm, list(rec.actives))},
+            )
+        # RSM convergence: poll app state AND the engine triple (a
+        # laggard may need many blocked-pull rounds); then audit
+        # exactly-once — equal frontiers must mean equal n_execd and
+        # equal app_hash.
+        converged = False
+        for _ in range(800):
+            states = {
+                c.ars.managers[a].app.state.get(nm) for a in rec.actives
+            }
+            fr = {
+                int(c.ars.managers[a]._np("exec_slot")[
+                    c.ars.managers[a].names[nm]])
+                for a in rec.actives
+                if c.ars.managers[a].names.get(nm) is not None
+            }
+            if len(states) == 1 and len(fr) == 1:
+                converged = True
+                break
+            step()
+        if not converged:
+            raise SoakDivergence(
+                "RSM divergence (app state or frontier never converged)",
+                {"name": nm, "members": _name_diag(c, nm, rec.actives)},
+            )
+        # equal frontiers ⇒ n_execd and app_hash must match exactly
+        diag = _name_diag(c, nm, rec.actives)
+        trips = {
+            (e["exec_slot"], e["n_execd"], e["app_hash"])
+            for e in diag.values() if "exec_slot" in e
+        }
+        if len(trips) != 1:
+            raise SoakDivergence(
+                "exactly-once breach: unequal (exec_slot, n_execd, "
+                "app_hash) at converged app state",
+                {"name": nm, "members": diag},
+            )
+    return settle_iters
+
+
 def run_soak(
     seed: int,
     *,
@@ -259,163 +425,184 @@ def run_soak(
             step()
             c.drain_client()
 
-        # lossless settle, deadline-bound (cold jax compiles and rare
-        # time-gated retransmits burn wall time, not steps)
-        c.msg_filter = None
-        deadline = time.time() + settle_budget_s
-        settled, settle_iters = False, 0
-        while not settled:
-            if time.time() > deadline:
-                break
-            for _ in range(8):
-                step()
-            c.drain_client()
-            settle_iters += 1
-            recs = {
-                nm: c.reconfigurators[0].rc_app.get_record(nm)
-                for nm in names
-            }
-            settled = all(
-                r is None or r.deleted
-                or r.state in (RCState.READY, RCState.PAUSED)
-                for r in recs.values()
-            )
-        if not settled:
-            # the WAIT_* liveness-wedge family lands HERE, so this message
-            # must carry the forensics: for each unsettled name, the full
-            # per-member diag including request timelines and the RCs'
-            # epoch-op timeline (which round is stalled, who never acked)
-            stuck = {
-                nm: r for nm, r in recs.items()
-                if r is not None and not r.deleted
-                and r.state not in (RCState.READY, RCState.PAUSED)
-            }
-            raise SoakDivergence(
-                "records did not settle",
-                {
-                    "records": {
-                        nm: (r.to_json() if r else None)
-                        for nm, r in recs.items()
-                    },
-                    "unsettled": {
-                        nm: _name_diag(
-                            c, nm,
-                            sorted(set(r.actives) | set(r.new_actives or []))
-                        )
-                        for nm, r in stuck.items()
-                    },
-                },
-            )
-
-        # record agreement across RCs
-        for nm in names:
-            views = [rc.rc_app.get_record(nm) for rc in c.reconfigurators]
-            datas = [None if v is None else v.to_json() for v in views]
-            if not all(d == datas[0] for d in datas):
-                raise SoakDivergence("RC record disagreement",
-                                     {"name": nm, "views": datas})
-
-        for nm, rec in recs.items():
-            if rec is None or rec.deleted:
-                # poll: a straggler that missed the drop (it could not
-                # ack while its stop was un-executed) heals through the
-                # audit-cadence redrop — give that machinery a window.
-                # Deadline-bound like the READY align loop below: the
-                # post-budget redrops fire at most once per audit period
-                # (wall-timer-gated), so a step-count cap alone can burn
-                # through on a fast box before the timers the heal needs
-                # have fired
-                drop_deadline = time.time() + 6 * max(
-                    rc.ready_audit_period_s for rc in c.reconfigurators
-                )
-                while time.time() < drop_deadline:
-                    if all(m.names.get(nm) is None for m in c.ars.managers):
-                        break
-                    step()
-                for m in c.ars.managers:
-                    if m.names.get(nm) is not None:
-                        raise SoakDivergence(
-                            "name lingers post-delete",
-                            {"name": nm, "member": m.my_id},
-                        )
-                continue
-            if rec.state is RCState.PAUSED:
-                held = [m for m in c.ars.managers
-                        if (nm, rec.epoch) in m.paused]
-                if not held:
-                    raise SoakDivergence(
-                        "paused with no pause records anywhere", {"name": nm}
-                    )
-                continue
-            # READY: actives host the name at ONE aligned row and agree.
-            # Re-read each poll: the deactivation sweep can pause a name
-            # mid-poll; commit-round re-drives heal missed starts.
-            rows: set = set()
-            # deadline-bound like the settle loop: the audit-cadence
-            # heals (READY audit re-running the commit round) are
-            # wall-timer-gated, so an iteration cap alone can expire
-            # before the timers their heals need have fired
-            align_deadline = time.time() + 90
-            while True:
-                rec = c.reconfigurators[0].rc_app.get_record(nm)
-                if rec is None or rec.deleted or \
-                        rec.state is not RCState.READY:
-                    break
-                rows = {c.ars.managers[a].names.get(nm) for a in rec.actives}
-                if rows == {rec.row} or time.time() > align_deadline:
-                    break
-                step()
-            if rec is None or rec.deleted or rec.state is not RCState.READY:
-                continue
-            if rows != {rec.row}:
-                raise SoakDivergence(
-                    "READY actives not aligned at record row",
-                    {"name": nm, "want_row": rec.row, "rows": sorted(
-                        (a, c.ars.managers[a].names.get(nm))
-                        for a in rec.actives),
-                     # which start/commit round stranded the outlier —
-                     # the 20260803 re-probe hit this shape blind
-                     "members": _name_diag(c, nm, list(rec.actives))},
-                )
-            # RSM convergence: poll app state AND the engine triple (a
-            # laggard may need many blocked-pull rounds); then audit
-            # exactly-once — equal frontiers must mean equal n_execd and
-            # equal app_hash.
-            converged = False
-            for _ in range(800):
-                states = {
-                    c.ars.managers[a].app.state.get(nm) for a in rec.actives
-                }
-                fr = {
-                    int(c.ars.managers[a]._np("exec_slot")[
-                        c.ars.managers[a].names[nm]])
-                    for a in rec.actives
-                    if c.ars.managers[a].names.get(nm) is not None
-                }
-                if len(states) == 1 and len(fr) == 1:
-                    converged = True
-                    break
-                step()
-            if not converged:
-                raise SoakDivergence(
-                    "RSM divergence (app state or frontier never converged)",
-                    {"name": nm, "members": _name_diag(c, nm, rec.actives)},
-                )
-            # equal frontiers ⇒ n_execd and app_hash must match exactly
-            diag = _name_diag(c, nm, rec.actives)
-            trips = {
-                (e["exec_slot"], e["n_execd"], e["app_hash"])
-                for e in diag.values() if "exec_slot" in e
-            }
-            if len(trips) != 1:
-                raise SoakDivergence(
-                    "exactly-once breach: unequal (exec_slot, n_execd, "
-                    "app_hash) at converged app state",
-                    {"name": nm, "members": diag},
-                )
+        settle_iters = settle_and_audit(
+            c, names, step, settle_budget_s
+        )
         return {"seed": seed, "settle_iters": settle_iters}
     finally:
         if c is not None:
+            c.close()
+        Config.clear()
+        for cls, p in zip(task_classes, saved_periods):
+            cls.restart_period_s = p
+
+
+def run_sharded_soak(
+    seed: int,
+    *,
+    workers: int = 2,
+    rounds: int = 50,
+    n_names: int = 6,
+    settle_budget_s: float = 420.0,
+    loss: float = 0.2,
+    dup_rate: float = 0.25,
+) -> Dict:
+    """Worker-sharded soak (``SERVING_WORKERS`` analog of the stepped
+    harness): the name space splits across ``workers`` independent shard
+    clusters exactly as the serving plane splits a node's groups across
+    worker processes (``gigapaxos_tpu/serving``: each shard is its own
+    consensus universe; the router's ONLY correctness obligations are
+    deterministic name→shard assignment and per-shard delivery).
+
+    What crossing the boundary must preserve — and what this audits:
+
+    * routing determinism: every operation (fresh traffic, duplicate
+      retransmit through a DIFFERENT entry, migration, pause, delete)
+      lands in the same shard its name always had (asserted per route);
+    * exactly-once across retransmits: duplicates re-propose into the
+      owning shard and dedup there — the end audit's
+      ``(exec_slot, n_execd, app_hash)`` triple + app-state agreement
+      run per shard;
+    * epoch handoffs (migrations/pauses) settle within their shard —
+      the full settle_and_audit gauntlet runs on every shard cluster.
+
+    Compressed timers, step-driven, no wall-clock gates (soak
+    conventions).  Raises :class:`SoakDivergence` on any violation.
+    """
+    from ..serving import shard_of_name
+
+    from ..reconfiguration import active_replica as ar_mod
+    from ..reconfiguration import reconfigurator as rc_mod
+
+    task_classes = (
+        rc_mod.StartEpochTask, rc_mod.StopEpochTask, rc_mod.DropEpochTask,
+        rc_mod.EpochCommitTask, rc_mod.LateStartTask, rc_mod.PauseEpochTask,
+        ar_mod.WaitEpochFinalState,
+    )
+    saved_periods = [cls.restart_period_s for cls in task_classes]
+    shards: List[ReconfigurableCluster] = []
+    try:
+        for cls in task_classes:
+            cls.restart_period_s = 0.05
+        Config.set("RESPONSE_CACHE_TTL_S", "3600")
+        rng = random.Random(seed)
+        ar_cfg = EngineConfig(n_groups=16, window=8, req_lanes=4,
+                              n_replicas=3)
+        rc_cfg = EngineConfig(n_groups=8, window=8, req_lanes=4,
+                              n_replicas=3)
+        n_ar = ar_cfg.n_replicas
+        from ..reconfiguration.placement import MeasureOnlyPlacementPolicy
+
+        for _w in range(workers):
+            c = ReconfigurableCluster(ar_cfg, rc_cfg, HashChainApp)
+            for m in c.ars.managers:
+                m.tracer.enabled = True
+            for rc in c.reconfigurators:
+                rc.REDRIVE_EVERY = 4
+                rc.ready_audit_period_s = 2.0
+                rc.echo_probe_period_s = 0.0
+                rc.placement.policy = MeasureOnlyPlacementPolicy(rc.placement)
+            shards.append(c)
+
+        names = [f"wn{i}" for i in range(n_names)]
+        owner = {nm: shard_of_name(nm, workers) for nm in names}
+
+        def route(nm: str) -> ReconfigurableCluster:
+            w = shard_of_name(nm, workers)
+            if w != owner[nm]:
+                raise SoakDivergence(
+                    "shard routing drifted for a name",
+                    {"name": nm, "was": owner[nm], "now": w},
+                )
+            return shards[w]
+
+        def step_all():
+            for c in shards:
+                c.step()
+            for c in shards:
+                probe_exactly_once(
+                    c, [nm for nm in names if shards[owner[nm]] is c]
+                )
+
+        for c in shards:
+            c.msg_filter = lambda dst, kind, body: rng.random() > loss
+        for nm in names:
+            route(nm).client_request(
+                "create_service",
+                {"name": nm, "actives": list(range(min(3, n_ar)))},
+            )
+        for _ in range(40):
+            step_all()
+
+        history = []  # (name, request_id, value) for duplicate replays
+        rid_base = (1 << 55) + seed % (1 << 20)
+        deleted: set = set()
+        for round_no in range(rounds):
+            op = rng.random()
+            nm = rng.choice(names)
+            c = route(nm)
+            if op < 0.45:  # traffic — fresh, or a duplicate retransmit
+                entry = rng.randrange(n_ar)
+                if history and rng.random() < dup_rate:
+                    # the retransmit goes through a DIFFERENT entry
+                    # replica but the SAME shard (route() asserts it)
+                    dn, rid, val = history[rng.randrange(len(history))]
+                    route(dn).ars.managers[entry].propose(
+                        dn, val, request_id=rid
+                    )
+                else:
+                    rid = rid_base + round_no
+                    val = f"r{round_no}"
+                    c.ars.managers[entry].propose(nm, val, request_id=rid)
+                    history.append((nm, rid, val))
+            elif op < 0.65:  # migrate within the shard's actives
+                target = rng.sample(range(n_ar), 3)
+                c.client_request(
+                    "reconfigure", {"name": nm, "new_actives": target}
+                )
+            elif op < 0.8:  # pause suggestion
+                rec = c.reconfigurators[0].rc_app.get_record(nm)
+                if rec is not None and not rec.deleted:
+                    c.active_replicas[0].send(
+                        ("RC", rng.randrange(rc_cfg.n_replicas)),
+                        "suggest_pause",
+                        {"name": nm, "epoch": rec.epoch, "from": 0},
+                    )
+            elif op < 0.92:  # touch
+                c.client_request("request_actives", {"name": nm})
+            elif nm not in deleted and len(deleted) < 2:
+                c.client_request("delete_service", {"name": nm})
+                deleted.add(nm)
+            step_all()
+            for c2 in shards:
+                c2.drain_client()
+
+        # settle + strict audit PER SHARD (each shard is a full
+        # consensus universe; the boundary property is that none of
+        # them ever saw another shard's names)
+        settle_iters = 0
+        for w, c in enumerate(shards):
+            mine = [nm for nm in names if owner[nm] == w]
+            foreign = [
+                nm for nm in names
+                if owner[nm] != w and any(
+                    nm in m.names for m in c.ars.managers
+                )
+            ]
+            if foreign:
+                raise SoakDivergence(
+                    "foreign names leaked across the worker-shard "
+                    "boundary", {"shard": w, "names": foreign},
+                )
+            def step_one(c=c):
+                c.step()
+            settle_iters += settle_and_audit(
+                c, mine, step_one, settle_budget_s
+            )
+        return {"seed": seed, "workers": workers,
+                "settle_iters": settle_iters}
+    finally:
+        for c in shards:
             c.close()
         Config.clear()
         for cls, p in zip(task_classes, saved_periods):

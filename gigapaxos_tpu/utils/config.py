@@ -209,6 +209,17 @@ class Config:
         return out
 
     @classmethod
+    def overrides(cls) -> Dict[str, str]:
+        """The merged file + programmatic/CLI override tiers, as raw
+        strings — what a parent must ship to a spawned worker process
+        (as ``key=value`` argv) for the child to see the same effective
+        config without sharing a properties file."""
+        with cls._lock:
+            merged = dict(cls._file_props)
+            merged.update(cls._cli)
+        return merged
+
+    @classmethod
     def clear(cls) -> None:
         """Reset all overrides (for tests)."""
         with cls._lock:

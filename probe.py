@@ -56,7 +56,26 @@ def main() -> int:
                     help="probe an ALREADY-RUNNING cluster booted from "
                          "this properties file (scripts/gp_server.py "
                          "start all) instead of booting nodes here")
+    ap.add_argument("--repeats", type=int, default=1,
+                    help="independent ramps; >1 reports a noise band "
+                         "(this host shows ~±40%% run-to-run)")
+    ap.add_argument("--pin-cores", default=None, metavar="LIST",
+                    help="comma-separated CPU ids to pin this process "
+                         "to (perf convention: pinned, ramp-only)")
+    ap.add_argument("--capacity-out", default=None, metavar="FILE",
+                    help="merge this run's capacity record into FILE "
+                         "(CAPACITY_rNN.json trajectory tracking)")
+    ap.add_argument("--label", default=None,
+                    help="record key inside --capacity-out (default: "
+                         "derived from mode flags)")
     args = ap.parse_args()
+
+    if args.pin_cores:
+        cores = {int(c) for c in args.pin_cores.split(",") if c != ""}
+        try:
+            os.sched_setaffinity(0, cores)
+        except (AttributeError, OSError) as e:
+            print(json.dumps({"warn": f"pin-cores failed: {e}"}))
 
     if args.cpu:
         # single-threaded XLA: N tick loops sharing a small host thrash
@@ -208,38 +227,88 @@ def main() -> int:
         client.send_request_sync(nm, "warm", timeout=30)
 
     n_injectors = args.clients
+    # pre-resolve every name's entry target ONCE (round-robin across the
+    # actives): the injector must not pay resolution/redirector cost per
+    # request — at probe rates the injector's own per-request constant
+    # deflates the measured SYSTEM capacity (sampling-profiled at ~40%
+    # of a loaded 1-core host before this fast path)
+    # route each name's traffic at its COORDINATOR (initial coord =
+    # members[row % |members|], the create-time rule): a non-coordinator
+    # entry must forward_batch every proposal — one extra frame encode/
+    # decode + two extra latency legs per request for 2/3 of the
+    # traffic.  Smart clients route at the leader; elections can move it
+    # (the forward path still handles that correctly, it just costs).
+    # Rows are emulated with the same deterministic probe the creator
+    # uses (crc32 % G, linear probe over occupancy in creation order).
+    from zlib import crc32 as _crc32
+
+    engine_rows = Config.get("ENGINE_ROWS") if args.attach else None
+    G_rows = int(engine_rows) if engine_rows else max(64, args.groups * 2)
+    occ = set()
+    targets = {}
+    for i, nm in enumerate(names):
+        acts = client.request_actives(nm) or [0, 1, 2]
+        acts = [a for a in acts if int(a) in client.actives]
+        row = _crc32(nm.encode("utf-8")) % G_rows
+        while row in occ:
+            row = (row + 1) % G_rows
+        occ.add(row)
+        target = acts[row % len(acts)] if acts else 0
+        targets[nm] = tuple(client.actives[int(target)])
+    # GC tuning: the request path allocates ~30 short-lived objects per
+    # request; default gen-0 cadence (700 allocs) costs measurable core
+    # at 25k+ req/s.  Harness-wide (all in-process nodes benefit).
+    import gc
+
+    gc.set_threshold(200000, 100, 100)
 
     def run_round(rate: float):
         """Fire at `rate` for window_s from N injector threads (the
         reference drives its probe with NUM_CLIENTS=9 senders,
-        ``TESTPaxosConfig.java:115``); return (resp_rate, mean_lat_s)."""
+        ``TESTPaxosConfig.java:115``).  Quantum-batched: each injector
+        wakes every few ms and fires the accrued quantum through the
+        prepared-send fast path, so harness overhead stays flat as the
+        rate ramps.  Returns (resp_rate, latencies_sorted)."""
         lock = threading.Lock()
-        done = []  # latencies
+        lats = []  # response latencies, seconds
         sent_counts = [0] * n_injectors
-
-        def cb_factory(t0):
-            def cb(rid, resp, error):
-                if not error:
-                    with lock:
-                        done.append(time.time() - t0)
-            return cb
+        QUANTUM_S = 0.004
 
         def inject(idx: int):
-            interval = n_injectors / rate
-            t_end = time.time() + args.window_s
-            next_t = time.time() + interval * idx / n_injectors
+            per_s = rate / n_injectors
+            t0 = time.time()
+            t_end = t0 + args.window_s
+            fired = 0
             i = 0
-            while time.time() < t_end:
+            while True:
                 now = time.time()
-                if now < next_t:
-                    time.sleep(min(interval, next_t - now))
+                if now >= t_end:
+                    break
+                due = int((now - t0) * per_s) - fired
+                if due <= 0:
+                    time.sleep(QUANTUM_S)
                     continue
-                next_t += interval
-                nm = names[(i * n_injectors + idx) % len(names)]
-                i += 1
-                client.send_request(nm, f"p{idx}x{i}", cb_factory(time.time()))
-                sent_counts[idx] += 1
+                t_batch = now  # one clock read per quantum (≤4ms skew)
 
+                def cb(rid, resp, error, _t=t_batch):
+                    if not error:
+                        lat = time.time() - _t
+                        with lock:
+                            lats.append(lat)
+
+                # group the quantum by entry target: ONE client lock +
+                # one aggregation enqueue per target per wake-up
+                by_target = {}
+                for _ in range(due):
+                    nm = names[(i * n_injectors + idx) % len(names)]
+                    i += 1
+                    by_target.setdefault(targets[nm], []).append(
+                        (nm, f"p{idx}x{i}")
+                    )
+                for addr, items in by_target.items():
+                    client.send_prepared_batch(addr, items, cb, t0=t_batch)
+                fired += due
+                sent_counts[idx] += due
         threads = [
             threading.Thread(target=inject, args=(j,), daemon=True)
             for j in range(n_injectors)
@@ -252,21 +321,31 @@ def main() -> int:
         time.sleep(min(1.0, args.latency_ms / 1000.0))
         sent = sum(sent_counts)
         with lock:
-            n_ok = len(done)
-            lat = sum(done) / n_ok if n_ok else float("inf")
-        return (n_ok / sent if sent else 0.0), lat
+            out = sorted(lats)
+        return (len(out) / sent if sent else 0.0), out
 
-    capacity = 0.0
-    rate = args.init_load
-    curve = []
-    try:
+    def pct(sorted_lats, q):
+        if not sorted_lats:
+            return float("inf")
+        k = min(len(sorted_lats) - 1, int(q * len(sorted_lats)))
+        return sorted_lats[k]
+
+    def run_ramp():
+        """One ramp-only capacity pass; returns (capacity, rounds)."""
+        capacity = 0.0
+        rate = args.init_load
+        curve = []
         for rnd in range(args.max_rounds):
-            resp_rate, lat = run_round(rate)
-            ok = resp_rate >= args.threshold and lat * 1000 <= args.latency_ms
+            resp_rate, lats = run_round(rate)
+            mean = sum(lats) / len(lats) if lats else float("inf")
+            ok = resp_rate >= args.threshold and \
+                mean * 1000 <= args.latency_ms
             line = {
                 "round": rnd, "load_rps": round(rate, 1),
                 "response_rate": round(resp_rate, 3),
-                "mean_latency_ms": round(lat * 1000, 1),
+                "mean_latency_ms": round(mean * 1000, 1),
+                "p50_ms": round(pct(lats, 0.50) * 1000, 1),
+                "p99_ms": round(pct(lats, 0.99) * 1000, 1),
                 "sustained": ok,
             }
             print(json.dumps(line), flush=True)
@@ -275,16 +354,78 @@ def main() -> int:
                 break
             capacity = rate
             rate *= args.factor
+        return capacity, curve
+
+    repeats = []
+    try:
+        for rep in range(max(1, args.repeats)):
+            if rep:
+                time.sleep(1.0)  # settle between ramps (ramp-only, no
+                # binary search: every repeat walks the same ladder)
+                print(json.dumps({"ramp": rep}), flush=True)
+            capacity, curve = run_ramp()
+            repeats.append({"capacity_rps": capacity, "rounds": curve})
+        caps = sorted(r["capacity_rps"] for r in repeats)
+        median = caps[len(caps) // 2]
+        noise_pct = (
+            (caps[-1] - caps[0]) / median * 100.0 if median else 0.0
+        )
         mode = "unreplicated (app+wire only)" if args.unreplicated \
-            else "full system path"
-        print(json.dumps({
+            else ("durable full system path" if args.durable
+                  else "full system path")
+        summary = {
             "metric": "system_capacity_requests_per_s",
-            "value": round(capacity, 1),
+            "value": round(median, 1),
+            "capacity_min_rps": round(caps[0], 1),
+            "capacity_max_rps": round(caps[-1], 1),
+            "noise_band_pct": round(noise_pct, 1),
+            "repeats": len(caps),
             "unit": f"req/s ({args.groups} groups, 3 actives + 3 RCs, "
                     f"loopback sockets, {mode})",
-            "protocol": f"x{args.factor} until resp<{args.threshold} "
-                        f"or latency>{args.latency_ms}ms",
-        }), flush=True)
+            "protocol": f"ramp-only x{args.factor} until "
+                        f"resp<{args.threshold} or "
+                        f"latency>{args.latency_ms}ms, "
+                        f"{max(1, args.repeats)} repeats",
+        }
+        print(json.dumps(summary), flush=True)
+        if args.capacity_out:
+            label = args.label or (
+                "unreplicated" if args.unreplicated
+                else ("durable" if args.durable else "in_process")
+            )
+            try:
+                with open(args.capacity_out) as f:
+                    doc = json.load(f)
+            except (OSError, ValueError):
+                doc = {
+                    "metric": "serving_capacity_trajectory",
+                    "host": {},
+                    "reference_floor_rps": 50000,
+                    "target_rps": 32000,
+                    "baseline_round5_rps": {"in_process": 15944,
+                                            "durable": 7320},
+                }
+            doc["host"] = {
+                "cpus": os.cpu_count(),
+                "pinned_cores": sorted(
+                    int(c) for c in (args.pin_cores or "").split(",")
+                    if c != ""
+                ),
+            }
+            doc[label] = {
+                "capacity_rps": summary["value"],
+                "min_rps": summary["capacity_min_rps"],
+                "max_rps": summary["capacity_max_rps"],
+                "noise_band_pct": summary["noise_band_pct"],
+                "repeats": [r["capacity_rps"] for r in repeats],
+                "curves": [r["rounds"] for r in repeats],
+                "protocol": summary["protocol"],
+            }
+            with open(args.capacity_out, "w") as f:
+                json.dump(doc, f, indent=1, sort_keys=True)
+            print(json.dumps(
+                {"capacity_out": args.capacity_out, "label": label}
+            ), flush=True)
         if args.in_process:
             # per-segment attribution (this process hosts the nodes, so
             # the global DelayProfiler aggregates all six tick loops)

@@ -1,0 +1,153 @@
+"""Binary hot-path codec ('R'/'S' frames, net/hot_codec.py): parity,
+golden bytes, malformed-frame rejection, and the native-toolchain
+lifecycle (in-venv build from source; CLEAN fallback when no compiler —
+the `stats` admin op must report which codec is live so a silent
+regression to the Python path can never masquerade as the fast path)."""
+
+import os
+import struct
+
+import pytest
+
+import gigapaxos_tpu.native as native
+from gigapaxos_tpu.net import hot_codec
+
+REQ_ITEMS = [
+    (123456789012345678, "probe0", "value-äß\x00end", False),
+    ((1 << 61) + 7, "n", "", True),
+    (1, "a" * 300, "v" * 5000, False),
+]
+RESP_ITEMS = [
+    {"request_id": 42, "response": "ok:1", "name": "probe0"},
+    {"request_id": 43, "response": None, "name": "x", "error": "overload"},
+    {"request_id": 44, "response": None, "name": "y",
+     "error": "unknown_name"},
+    {"request_id": 45, "response": "", "name": "z", "error": "exhausted"},
+]
+
+# golden bytes pin the WIRE layout (computed from the documented layout,
+# not from the codec — a layout change must fail here, not silently
+# re-golden): one item, rid=7, stop, name "ab", value "c"
+GOLDEN_R = (
+    b"R" + struct.pack("<iI", -1, 1)
+    + struct.pack("<QBHI", 7, 1, 2, 1) + b"ab" + b"c"
+)
+# rid=9, err overload(1), no response, name "n"
+GOLDEN_S = (
+    b"S" + struct.pack("<iI", 2, 1)
+    + struct.pack("<QBBHI", 9, 1, 0, 1, 0) + b"n"
+)
+
+
+@pytest.fixture(params=["native", "python"])
+def codec_mode(request, monkeypatch):
+    """Run the test body under the native codec AND the pure-Python
+    fallback (same pattern as tests/test_recovery.py's journal runs)."""
+    if request.param == "python":
+        monkeypatch.setenv("GP_NO_NATIVE", "1")
+    native._libs.clear()
+    yield request.param
+    native._libs.clear()
+
+
+def test_round_trip_requests(codec_mode):
+    frame = hot_codec.encode_request_batch(-1, REQ_ITEMS)
+    if codec_mode == "native" and not hot_codec.native_active():
+        pytest.skip("no toolchain in this environment")
+    assert hot_codec.decode_request_batch(frame) == (-1, REQ_ITEMS)
+
+
+def test_round_trip_responses(codec_mode):
+    frame = hot_codec.encode_response_batch(5, RESP_ITEMS)
+    sender, items = hot_codec.decode_response_batch(frame)
+    assert sender == 5
+    assert items == RESP_ITEMS
+
+
+def test_golden_bytes(codec_mode):
+    assert hot_codec.encode_request_batch(
+        -1, [(7, "ab", "c", True)]
+    ) == GOLDEN_R
+    assert hot_codec.encode_response_batch(2, [{
+        "request_id": 9, "response": None, "name": "n",
+        "error": "overload",
+    }]) == GOLDEN_S
+
+
+def test_native_python_parity():
+    """The two implementations must be byte-identical BOTH directions on
+    the same inputs (the golden test pins one point; this pins many)."""
+    native._libs.clear()
+    os.environ.pop("GP_NO_NATIVE", None)
+    if not hot_codec.native_active():
+        pytest.skip("no toolchain in this environment")
+    na_r = hot_codec.encode_request_batch(-1, REQ_ITEMS)
+    na_s = hot_codec.encode_response_batch(3, RESP_ITEMS)
+    na_rd = hot_codec.decode_request_batch(na_r)
+    na_sd = hot_codec.decode_response_batch(na_s)
+    os.environ["GP_NO_NATIVE"] = "1"
+    native._libs.clear()
+    try:
+        assert not hot_codec.native_active()
+        assert hot_codec.encode_request_batch(-1, REQ_ITEMS) == na_r
+        assert hot_codec.encode_response_batch(3, RESP_ITEMS) == na_s
+        assert hot_codec.decode_request_batch(na_r) == na_rd
+        assert hot_codec.decode_response_batch(na_s) == na_sd
+    finally:
+        del os.environ["GP_NO_NATIVE"]
+        native._libs.clear()
+
+
+def test_malformed_frames_rejected(codec_mode):
+    good = hot_codec.encode_request_batch(-1, REQ_ITEMS)
+    for bad in (
+        b"", b"R", good[:-1], good + b"x",
+        b"R" + struct.pack("<iI", -1, 99) + b"\x00" * 10,
+        b"J" + good[1:],
+    ):
+        with pytest.raises(ValueError):
+            hot_codec.decode_request_batch(bad)
+    goods = hot_codec.encode_response_batch(1, RESP_ITEMS)
+    for bad in (b"", goods[:-1], goods + b"y"):
+        with pytest.raises(ValueError):
+            hot_codec.decode_response_batch(bad)
+
+
+def test_unknown_error_string_falls_back_to_json():
+    item = {"request_id": 1, "response": None, "name": "n",
+            "error": "weird_new_error"}
+    assert not hot_codec.encodable_response(item)
+    assert hot_codec.encodable_response(RESP_ITEMS[0])
+
+
+def test_native_builds_from_source_in_venv(tmp_path):
+    """Tier-1 toolchain gate: the codec library builds from its .cc with
+    the system compiler, and a MISSING toolchain degrades cleanly to the
+    Python codec (no exception, status() says so)."""
+    so = os.path.join(os.path.dirname(native.__file__), "libgp_codec.so")
+    native._libs.clear()
+    os.environ.pop("GP_NO_NATIVE", None)
+    if os.path.exists(so):
+        os.unlink(so)  # force a rebuild from source
+    lib = native.codec_lib()
+    if lib is None:
+        pytest.skip("no C++ toolchain in this environment")
+    assert os.path.exists(so), "build did not produce the shared object"
+    assert hot_codec.status()["impl"] == "gp_codec.so"
+
+
+def test_clean_fallback_when_toolchain_absent(monkeypatch):
+    """Simulate a host with no compiler: loader returns None, codec
+    still round-trips via Python, and status() reports the regression
+    (the `stats` admin op surfaces this — tested in test_pipeline)."""
+    so = os.path.join(os.path.dirname(native.__file__), "libgp_codec.so")
+    native._libs.clear()
+    monkeypatch.setattr(native, "_build", lambda src, so_: False)
+    if os.path.exists(so):
+        os.unlink(so)
+    assert native.codec_lib() is None
+    st = hot_codec.status()
+    assert st["native"] is False and st["impl"] == "python-struct"
+    frame = hot_codec.encode_request_batch(-1, REQ_ITEMS)
+    assert hot_codec.decode_request_batch(frame) == (-1, REQ_ITEMS)
+    native._libs.clear()  # let later tests rebuild
