@@ -811,6 +811,15 @@ class PaxosManager:
         )
         return out
 
+    def mesh_info(self) -> Dict[str, Any]:
+        """{n_devices, shape, platform} of the devices backing the engine
+        state — surfaced on the ``stats`` admin op so an accidentally
+        unsharded deployment (a G meant for a mesh sitting on one device)
+        is visible at runtime, not discovered in an OOM."""
+        from .parallel.mesh import describe_state_mesh
+
+        return describe_state_mesh(self.state.bal)
+
     def local_read_ok(self, name: str) -> bool:
         """Gate for the uncoordinated local-read fast path: False while
         the name's app state is un-hydrated (and promotes it to the

@@ -1,12 +1,22 @@
 """SPMD wrappers for the consensus step.
 
-Two execution modes over the same pure :func:`gigapaxos_tpu.ops.engine.step`:
+Three execution modes over the same pure :func:`gigapaxos_tpu.ops.engine.step`:
 
 * :func:`spmd_step` — shard_map over a ``(g, r)`` mesh: each replica chip
   holds its own engine state shard; the blob exchange is a single
-  ``lax.all_gather`` over the replica axis (ICI).  This is the real
-  multi-chip deployment shape (BASELINE.json: 3 chips as acceptors) and
-  what the driver's ``dryrun_multichip`` exercises.
+  ``lax.all_gather`` over the replica axis (ICI).  This is the
+  acceptor-per-chip deployment shape (BASELINE.json: 3 chips as acceptors)
+  and what the driver's ``dryrun_multichip`` exercises.
+
+* :func:`group_sharded_step` — shard_map over a 1-D ``('g',)`` mesh
+  covering ALL devices: each device hosts G/n_shards groups × all R
+  replica rows, so the blob "exchange" is the device-local stacked blobs
+  and the step has **zero cross-device collectives** (groups are fully
+  independent).  This is the weak-scaling headline shape: aggregate
+  dec/s and hosted-group capacity both scale ~linearly with the mesh,
+  and per-device HBM is ``bytes_per_group x G / n_shards``.  A G that
+  does not divide the mesh pads with inert rows (``pad_group_states``)
+  which the step keeps frozen (member_mask 0 -> non-member -> no-op).
 
 * :func:`single_chip_step` — all R replica states stacked on one device and
   advanced with ``vmap``; the "gather" is just the stacked blobs.  This is
@@ -14,7 +24,8 @@ Two execution modes over the same pure :func:`gigapaxos_tpu.ops.engine.step`:
   reference's N-nodes-in-one-JVM testing mode, ``PaxosManager.java:108-111``).
 
 Global array convention for SPMD: every state leaf gets a leading replica
-axis -> ``[R, G, ...]`` sharded ``P('r', 'g')``; inputs likewise.
+axis -> ``[R, G, ...]``; ``spmd_step`` shards ``P('r', 'g')``,
+``group_sharded_step`` shards ``P(None, 'g')`` (replica axis device-local).
 """
 
 from __future__ import annotations
@@ -176,3 +187,136 @@ def replicate_inputs(mesh: Mesh, states: EngineState, req_vid, want_coord):
     req_vid = jax.device_put(req_vid, sh(P(REPLICA_AXIS, GROUP_AXIS, None)))
     want_coord = jax.device_put(want_coord, sh(P(REPLICA_AXIS, GROUP_AXIS)))
     return states, req_vid, want_coord
+
+
+# ---------------------------------------------------------------------------
+# Group-sharded SPMD: the G axis partitioned over ALL mesh devices, every
+# device holding all R replica rows for its slice — zero cross-device
+# collectives (see the module docstring).
+# ---------------------------------------------------------------------------
+
+
+def padded_group_count(n_groups: int, n_shards: int) -> int:
+    """Smallest shard-divisible G' >= n_groups (ceil to a multiple)."""
+    return -(-n_groups // n_shards) * n_shards
+
+
+def pad_group_states(cfg: EngineConfig, states: EngineState,
+                     n_shards: int) -> EngineState:
+    """Pad stacked [R, G, ...] states to a shard-divisible G with INERT
+    rows (member_mask 0): the step freezes non-member rows, so padding
+    changes no real group's transition and the padded tail stays at its
+    init values bit-for-bit."""
+    from ..ops.engine import init_state
+
+    Gp = padded_group_count(cfg.n_groups, n_shards)
+    if Gp == cfg.n_groups:
+        return states
+    pad_cfg = cfg._replace(n_groups=Gp - cfg.n_groups)
+    pad = stack_states([init_state(pad_cfg) for _ in range(cfg.n_replicas)])
+    return jax.tree.map(
+        lambda a, b: jnp.concatenate([a, b], axis=1), states, pad
+    )
+
+
+def pad_group_inputs(cfg: EngineConfig, n_shards: int, req_vid, want_coord):
+    """Pad [R, G, K] requests (NULL) and [R, G] election pulses (False)
+    to the shard-divisible G."""
+    from ..ops.engine import NULL as _NULL
+
+    Gp = padded_group_count(cfg.n_groups, n_shards)
+    G = cfg.n_groups
+    if Gp == G:
+        return jnp.asarray(req_vid), jnp.asarray(want_coord)
+    R, K = cfg.n_replicas, cfg.req_lanes
+    req = jnp.concatenate([
+        jnp.asarray(req_vid),
+        jnp.full((R, Gp - G, K), _NULL, jnp.int32),
+    ], axis=1)
+    want = jnp.concatenate([
+        jnp.asarray(want_coord),
+        jnp.zeros((R, Gp - G), bool),
+    ], axis=1)
+    return req, want
+
+
+def strip_group_pad(tree, n_groups: int):
+    """Slice the padded G axis (axis 1) back to the real group count —
+    host-side readback only; keep the persistent arrays padded."""
+    return jax.tree.map(lambda x: x[:, :n_groups], tree)
+
+
+def shard_group_inputs(mesh: Mesh, cfg: EngineConfig, states: EngineState,
+                       req_vid, want_coord):
+    """Pad to the mesh's shard count and device_put with the group-sharded
+    layout: states/want ``P(None, 'g')``, requests ``P(None, 'g', None)``.
+    Returns (states, req_vid, want_coord) ready for group_sharded_step."""
+    n_shards = mesh.shape[GROUP_AXIS]
+    states = pad_group_states(cfg, states, n_shards)
+    req_vid, want_coord = pad_group_inputs(cfg, n_shards, req_vid, want_coord)
+    sh = lambda spec: NamedSharding(mesh, spec)
+    states = jax.tree.map(
+        lambda x: jax.device_put(x, sh(P(None, GROUP_AXIS))), states
+    )
+    req_vid = jax.device_put(req_vid, sh(P(None, GROUP_AXIS, None)))
+    want_coord = jax.device_put(want_coord, sh(P(None, GROUP_AXIS)))
+    return states, req_vid, want_coord
+
+
+def group_sharded_step(cfg: EngineConfig, mesh: Mesh, donate: bool = True):
+    """shard_map step over a 1-D ('g',) mesh: G partitioned, R device-local.
+
+    Global args: states [R, Gp, ...] with ``P(None, 'g')`` (Gp = G padded
+    up to a multiple of the mesh, ``pad_group_states``); req_vid
+    [R, Gp, K]; want_coord [R, Gp]; heard (optional) [R(recv), R(send)]
+    bool delivery matrix, replicated (every shard applies the same fault
+    pattern — the host FD is per-node, not per-group-shard).
+
+    Each shard runs the single-chip vmap step over its [R, Gp/n, ...]
+    slice: the blob "exchange" is the locally stacked blobs, so the body
+    contains NO collectives — the compiled step is pure per-device work
+    and weak-scales linearly by construction.  ``donate=True`` aliases
+    the old state shards into the new ones (per-device HBM stays
+    ``bytes_per_group x Gp / n_shards``, one copy)."""
+    R = cfg.n_replicas
+    n_shards = mesh.shape[GROUP_AXIS]
+    Gp = padded_group_count(cfg.n_groups, n_shards)
+    local_cfg = cfg._replace(n_groups=Gp // n_shards)
+    my_ids = jnp.arange(R, dtype=jnp.int32)
+
+    gspec = P(None, GROUP_AXIS)
+    state_spec = EngineState(*([gspec] * len(EngineState._fields)))
+    out_spec = StepOutputs(*([gspec] * len(StepOutputs._fields)))
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            state_spec,
+            P(None, GROUP_AXIS, None),
+            P(None, GROUP_AXIS),
+            P(None, None),
+        ),
+        out_specs=(state_spec, out_spec),
+        **_SHARD_MAP_CHECK_KW,
+    )
+    def _sharded(states, req_vid, want_coord, heard):
+        # local shapes: leaves [R, Gp/n, ...]; heard [R, R] (replicated)
+        h = heard | jnp.eye(R, dtype=bool)
+        blobs = jax.vmap(make_blob)(states)
+
+        def _one(state, heard_row, req, want, my_id):
+            return step(state, blobs, heard_row, req, want, my_id, local_cfg)
+
+        return jax.vmap(_one, in_axes=(0, 0, 0, 0, 0))(
+            states, h, req_vid, want_coord, my_ids
+        )
+
+    fn = jax.jit(_sharded, donate_argnums=(0,) if donate else ())
+
+    def run(states, req_vid, want_coord, heard=None):
+        if heard is None:
+            heard = jnp.ones((R, R), bool)
+        return fn(states, req_vid, want_coord, jnp.asarray(heard, bool))
+
+    return run

@@ -622,7 +622,13 @@ class PaxosServer:
                     "codec": hot_codec.status(),
                     "serving_workers": Config.get_int(PC.SERVING_WORKERS),
                 },
-                "engine": self.manager.metrics.snapshot(),
+                # engine counters + the mesh actually backing the state
+                # arrays (n_devices/shape/platform): an accidentally
+                # unsharded deployment is a stats read away, not an OOM
+                "engine": {
+                    **self.manager.metrics.snapshot(),
+                    "mesh": self.manager.mesh_info(),
+                },
                 "profiler": DelayProfiler.get_snapshot(),
                 "profiler_line": DelayProfiler.get_stats(),
             }

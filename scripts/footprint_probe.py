@@ -9,8 +9,19 @@ budget without a TPU.
 Usage:
     python scripts/footprint_probe.py [--groups G] [--window W]
                                       [--req-lanes K] [--replicas R]
+                                      [--sharded N]
 
 Defaults are the headline bench shape (G=1,048,576, W=32, K=16, R=3).
+
+``--sharded N`` adds the group-sharded SPMD deployment arithmetic
+(``parallel/spmd.py:group_sharded_step``): G pads up to a multiple of N,
+each device hosts padded_G/N groups x all R replica rows, and the
+per-device peak is exactly the single-chip model at the local group
+count.  The mode ASSERTS the per-device blob cost per hosted group stays
+at the compact-blob budget (16 + 16*W bytes/group/replica-row — 528 B at
+W=32): sharding must never add per-group exchange overhead, and a future
+format regression that fans a per-shard plane into the blob fails the
+probe (exit 1), not a TPU run.
 
 The transient model: the step's cross-replica reductions fold one peer
 row at a time with [G, W] carries (11 planes across the two folds), the
@@ -73,15 +84,61 @@ def probe(G: int, W: int, K: int, R: int) -> dict:
     }
 
 
+def probe_sharded(G: int, W: int, K: int, R: int, n_shards: int) -> dict:
+    """Group-sharded deployment arithmetic + the per-group budget assert."""
+    from gigapaxos_tpu.parallel.spmd import padded_group_count
+
+    Gp = padded_group_count(G, n_shards)
+    g_loc = Gp // n_shards
+    local = probe(g_loc, W, K, R)
+    budget_b = 16 + 16 * W  # 4*(4 [G] + 4*W [G, W]) int32 -> 528 at W=32
+    per_group = local["blob_bytes_per_replica"] / g_loc
+    out = {
+        "n_shards": n_shards,
+        "padded_groups": Gp,
+        "groups_per_device": g_loc,
+        "pad_overhead_pct": round(100.0 * (Gp - G) / G, 2),
+        # each device hosts ALL R replica rows of its shard: the exchange
+        # is the locally stacked blobs (no gathered peer rows)
+        "per_device_state_bytes": R * local["state_bytes_per_replica"],
+        "per_device_blob_bytes": R * local["blob_bytes_per_replica"],
+        "per_device_blob_bytes_per_group": round(per_group, 1),
+        "compact_budget_bytes_per_group": budget_b,
+        "per_device_peak_estimate_bytes":
+            local["single_chip_peak_estimate_bytes"],
+        "per_device_peak_estimate_gib":
+            local["single_chip_peak_estimate_gib"],
+        "within_budget": per_group <= budget_b,
+    }
+    return out
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--groups", "-G", type=int, default=1_048_576)
     ap.add_argument("--window", "-W", type=int, default=32)
     ap.add_argument("--req-lanes", "-K", type=int, default=16)
     ap.add_argument("--replicas", "-R", type=int, default=3)
+    ap.add_argument("--sharded", "-N", type=int, default=0, metavar="N",
+                    help="add group-sharded arithmetic for an N-device "
+                         "mesh and assert the per-group blob budget")
     args = ap.parse_args()
-    print(json.dumps(probe(args.groups, args.window, args.req_lanes,
-                           args.replicas)))
+    out = probe(args.groups, args.window, args.req_lanes, args.replicas)
+    if args.sharded > 0:
+        out["sharded"] = probe_sharded(
+            args.groups, args.window, args.req_lanes, args.replicas,
+            args.sharded,
+        )
+    print(json.dumps(out))
+    if args.sharded > 0 and not out["sharded"]["within_budget"]:
+        print(
+            f"FOOTPRINT BUDGET EXCEEDED: "
+            f"{out['sharded']['per_device_blob_bytes_per_group']} B/group "
+            f"> {out['sharded']['compact_budget_bytes_per_group']} B/group "
+            f"compact-blob budget at {args.sharded} shards",
+            file=sys.stderr,
+        )
+        return 1
     return 0
 
 

@@ -239,6 +239,11 @@ def test_stats_admin_roundtrip_and_unknown_op():
             assert time.time() < deadline, eng["counters"]
             time.sleep(0.2)
         assert "engine_step_s" in eng["hists"]
+        # the mesh actually backing the state arrays rides along — an
+        # unsharded deployment must be visible at runtime
+        assert eng["mesh"]["n_devices"] >= 1
+        assert eng["mesh"]["platform"] == "cpu"
+        assert isinstance(eng["mesh"]["shape"], dict)
         # blob publishing happened, so the wire-cost counters are live
         assert eng["counters"].get("blob_bytes_sent", 0) > 0
         assert "profiler" in r and "counts" in r["profiler"]
