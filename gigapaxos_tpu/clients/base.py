@@ -22,12 +22,16 @@ from typing import Callable, Dict, List, Optional, Tuple
 from ..net import hot_codec
 from ..net.codec import encode_json
 from ..net.transport import MAGIC, _HDR
+from ..obs.metrics import MetricsRegistry
+from ..obs.reqtrace import maybe_mint_trace, trace_sample_rate
 from ..paxos_config import PC
 from ..utils.config import Config
 
 # the only body shape the binary 'R' frame can carry; anything richer
-# (future fields) falls back to the JSON frame for the whole batch
-_R_BODY_KEYS = frozenset(("name", "value", "request_id", "stop"))
+# (future fields) falls back to the JSON frame for the whole batch.
+# "tc" is the cross-node trace context — a first-class fixed-layout
+# field in the R frame, not a fallback trigger
+_R_BODY_KEYS = frozenset(("name", "value", "request_id", "stop", "tc"))
 
 Addr = Tuple[str, int]
 
@@ -74,6 +78,26 @@ class AsyncFrameClient:
         # binary hot-path frames ('R' out / 'S' back, net/hot_codec.py):
         # one fixed-layout scan per frame instead of a JSON round trip
         self._binary_frames = Config.get_bool(PC.BINARY_CLIENT_FRAMES)
+        # cross-node trace sampling (GP_TRACE_SAMPLE, snapshotted: an env
+        # read per request would be hot-path cost) + the client-side SLO
+        # surface: end-to-end request latency lands in a log-bucket
+        # histogram here — the "client wait" phase the server can't see
+        self._trace_rate = trace_sample_rate()
+        self.metrics = MetricsRegistry(node=-1)
+
+    def _mint_trace(self):
+        """Sampling decision for one outgoing request: (tid, origin,
+        hop=0) or None.  Zero-cost when sampling is off."""
+        if not self._trace_rate:
+            return None
+        return maybe_mint_trace(
+            getattr(self, "my_tag", -1), self._trace_rate
+        )
+
+    def _observe_latency(self, t_sent: float, now: float) -> None:
+        """One end-to-end latency sample (response received for a
+        request registered at ``t_sent``)."""
+        self.metrics.observe("client_request_latency_s", now - t_sent)
 
     def mint_id(self) -> int:
         with self._lock:
@@ -147,10 +171,14 @@ class AsyncFrameClient:
             rid = b.get("request_id")
             if rid is None or not _R_BODY_KEYS.issuperset(b):
                 return None
-            items.append((
+            item = (
                 int(rid), b["name"], b.get("value", ""),
                 bool(b.get("stop")),
-            ))
+            )
+            tc = b.get("tc")
+            if tc:
+                item += ((int(tc[0]), int(tc[1]), int(tc[2])),)
+            items.append(item)
         try:
             return hot_codec.encode_request_batch(tag, items)
         except (ValueError, OverflowError, struct.error):

@@ -42,10 +42,14 @@ class PaxosClientAsync(AsyncFrameClient):
             if callback is not None:
                 self._callbacks[request_id] = (time.time(), callback)
         idx = random.randrange(len(self.servers)) if server is None else server
-        self.send_request_body(tuple(self.servers[idx]), {
+        body = {
             "name": name, "value": value,
             "request_id": request_id, "stop": stop,
-        })
+        }
+        tc = self._mint_trace()
+        if tc is not None:
+            body["tc"] = list(tc)
+        self.send_request_body(tuple(self.servers[idx]), body)
         return request_id
 
     def send_request_sync(
@@ -158,4 +162,5 @@ class PaxosClientAsync(AsyncFrameClient):
             # REQUEST_TIMEOUT_S sweep (the PaxosClientAsync 8s GC analog)
             self._gc_callbacks_locked(now)
         if ent:
+            self._observe_latency(ent[0], now)
             ent[1](rid, body.get("response"))

@@ -414,10 +414,14 @@ class ReconfigurableAppClient(AsyncFrameClient):
             # as a floor sample, or a server slower than the retransmit
             # interval would never accumulate any RTT evidence at all
             self.redirector.record(prev[2], time.time() - prev[0])
-        self.send_request_body(addr, {
+        body = {
             "name": name, "value": value,
             "request_id": request_id, "stop": stop,
-        })
+        }
+        tc = self._mint_trace()
+        if tc is not None:
+            body["tc"] = list(tc)
+        self.send_request_body(addr, body)
         return request_id
 
     def send_prepared(
@@ -440,9 +444,13 @@ class ReconfigurableAppClient(AsyncFrameClient):
                 request_id = self._next_id
             # target None: no RTT attribution (the harness pins targets)
             self._callbacks[request_id] = (time.time(), callback, None, 1)
-        self.send_request_body(addr, {
+        body = {
             "name": name, "value": value, "request_id": request_id,
-        })
+        }
+        tc = self._mint_trace()
+        if tc is not None:
+            body["tc"] = list(tc)
+        self.send_request_body(addr, body)
         return request_id
 
     def send_prepared_batch(
@@ -458,15 +466,21 @@ class ReconfigurableAppClient(AsyncFrameClient):
         injector's locks amortize per wake-up instead of per request."""
         now = time.time() if t0 is None else t0
         bodies = []
+        trace = bool(self._trace_rate)
         with self._lock:
             rid0 = self._next_id + 1
             self._next_id += len(items)
             for k, (name, value) in enumerate(items):
                 self._callbacks[rid0 + k] = (now, callback, None, 1)
         for k, (name, value) in enumerate(items):
-            bodies.append({
+            body = {
                 "name": name, "value": value, "request_id": rid0 + k,
-            })
+            }
+            if trace:
+                tc = self._mint_trace()
+                if tc is not None:
+                    body["tc"] = list(tc)
+            bodies.append(body)
         self.send_request_bodies(addr, bodies)
         return list(range(rid0, rid0 + len(items)))
 
@@ -568,4 +582,6 @@ class ReconfigurableAppClient(AsyncFrameClient):
             if not body.get("error") and ent[2] is not None \
                     and int(sender) == int(ent[2]) and ent[3] == 1:
                 self.redirector.record(ent[2], now - ent[0])
+            if not body.get("error"):
+                self._observe_latency(ent[0], now)
             ent[1](rid, body.get("response"), body.get("error"))

@@ -97,6 +97,16 @@ def _metrics_body(metrics: Optional[Callable[[], str]]) -> str:
 def _http_server(host: str, port: int, handler_cls) -> ThreadingHTTPServer:
     srv = ThreadingHTTPServer((host, port), handler_cls)
     srv.daemon_threads = True
+    # the HTTP fronts are CLIENT-plane listeners: under a TLS deployment
+    # they serve HTTPS with the same contexts/policy as the client
+    # socket plane (SERVER_AUTH presents the node cert; MUTUAL_AUTH
+    # additionally requires a client cert — a cert-less scraper is
+    # rejected at the handshake, same as a cert-less binary client)
+    from .net.ssl_util import build_client_plane_contexts
+
+    ctx, _dialer = build_client_plane_contexts()
+    if ctx is not None:
+        srv.socket = ctx.wrap_socket(srv.socket, server_side=True)
     t = threading.Thread(target=srv.serve_forever, daemon=True,
                          name=f"http-{port}")
     t.start()

@@ -64,6 +64,7 @@ from .ops.engine import (
     step_host,
 )
 from .obs import gplog
+from .obs.flight import FlightRecorder
 from .obs.metrics import MetricsRegistry
 from .obs.reqtrace import RequestTracer
 from .ops.lifecycle import create_groups, kill_groups
@@ -235,10 +236,35 @@ class PaxosManager:
         self.log = gplog.node_logger("manager", my_id)
         self.tracer = RequestTracer(my_id)
         self.metrics = MetricsRegistry(node=my_id)
+        # black-box flight recorder (obs/flight.py): always-on bounded
+        # rings of per-step engine summaries + last-K decided
+        # (group, slot, ballot, vid), dumped on divergence/exception/
+        # `flightdump` — O(1) per tick, fed from _post_step_locked
+        self.flight = FlightRecorder(my_id)
+        # cross-node trace contexts: request_id -> (trace_id, origin,
+        # hop) for requests sampled at their origin (GP_TRACE_SAMPLE).
+        # Installed at propose time (client frame / forward) or from
+        # payload gossip, read on the decide/execute/flush paths so
+        # every hop's reqtrace events share the trace id.  Bounded FIFO;
+        # mutations run under the state lock, the flush path's read is
+        # a benign racy dict lookup (diagnostics only).
+        self.trace_ctx: "Dict[int, Tuple[int, int, int]]" = {}
+        self.TRACE_CTX_CAP = 8192
+        # contexts installed HERE since the last tick, to gossip to
+        # peers on the payloads frame (drained by _post_step_locked) —
+        # peers need the context to stamp their decide/execute events
+        self._tc_gossip: Dict[int, Tuple[int, int, int]] = {}
         # host cache of each row's last-known coordinator id (from the
         # promised ballot) — flip counting reads `bal` only on the rare
         # ticks where a ballot actually rose (bal_new nonzero)
         self._coord_cache = np.full(G, -1, np.int32)
+        # host view of each row's promised ballot, under the same
+        # discipline: seeded at create (the initial ballot is computed
+        # host-side), refreshed from the one rise-tick `bal` pull.  The
+        # decide events' (group, slot, ballot) attribution and the
+        # flight recorder's decided ring read THIS, never the device —
+        # a per-commit-tick `bal` pull costs a device sync per tick
+        self._bal_host = np.full(G, NULL, np.int32)
 
         # explicit ctor args win; otherwise the three-tier flag system
         # decides (defaults < properties file < env/CLI — PaxosConfig.PC)
@@ -971,6 +997,9 @@ class PaxosManager:
             np.array([coord0]), my_id=self.my_id, version=version,
             tag=_instance_tag(name, version),
         )
+        # the implicit initial ballot (0, coord0) is known host-side:
+        # seed the decide-attribution view without touching the device
+        self._bal_host[row] = encode_ballot(0, coord0)
         self.app_exec_slot[row] = 0
         self._release_row_queue(row)  # stale leftovers of a prior tenant
         self.pending_exec.pop(row, None)
@@ -1615,6 +1644,36 @@ class PaxosManager:
             )
 
     # ------------------------------------------------------------------
+    # cross-node trace plumbing (obs/reqtrace.py)
+    # ------------------------------------------------------------------
+    def _install_trace_locked(
+        self, request_id: int, tc, gossip: bool = True
+    ) -> None:
+        """Remember a sampled request's trace context (state lock held).
+        ``gossip=True`` queues it for the next payloads frame so every
+        replica can stamp its decide/execute events; gossip-received
+        contexts install with ``gossip=False`` (re-broadcasting them
+        would ping-pong; the origin's broadcast already reached all
+        peers)."""
+        if tc is None or request_id is None:
+            return
+        d = self.trace_ctx
+        if request_id not in d and len(d) >= self.TRACE_CTX_CAP:
+            # bounded FIFO: dict preserves insertion order
+            for k in list(
+                itertools.islice(d, max(1, self.TRACE_CTX_CAP // 8))
+            ):
+                del d[k]
+        d.setdefault(request_id, tc)
+        if gossip:
+            self._tc_gossip[request_id] = d[request_id]
+
+    @staticmethod
+    def _tc_detail(tc) -> Dict:
+        """Event-detail fields for a trace context (empty when None)."""
+        return {} if tc is None else {"tid": tc[0], "hop": tc[2]}
+
+    # ------------------------------------------------------------------
     # propose (PaxosManager.propose/proposeStop, :1195-1390)
     # ------------------------------------------------------------------
     def propose(
@@ -1625,9 +1684,14 @@ class PaxosManager:
         stop: bool = False,
         request_id: Optional[int] = None,
         entry_replica: Optional[int] = None,
+        trace_ctx=None,
     ) -> Optional[int]:
         """Enqueue a request for consensus; returns the assigned vid (or
-        None if the name is unknown here).
+        None if the name is unknown here).  ``trace_ctx`` is the optional
+        cross-node ``(trace_id, origin, hop)`` a sampled request arrived
+        with — installed for the decide/execute/flush hops and recorded
+        even when the local tracer is off (sampling is decided at the
+        origin).
 
         Thread-safe: callable from transport threads concurrently with the
         tick loop (the lock covers the vid counter and the queue/arena
@@ -1718,10 +1782,13 @@ class PaxosManager:
                 self.row_activity[row] = time.time()
                 self.demand_counts[name] = self.demand_counts.get(name, 0) + 1
                 self.demand_backlog += 1
-                if self.tracer.enabled:
+                self._install_trace_locked(request_id, trace_ctx)
+                if self.tracer.enabled or trace_ctx is not None:
                     self.tracer.note(
                         request_id, "propose", name=name, node=self.my_id,
                         vid=vid, row=row, entry=entry, stop=bool(stop),
+                        force=trace_ctx is not None,
+                        **self._tc_detail(trace_ctx),
                     )
         if emulated is not None:
             counter, request_id = emulated
@@ -1748,9 +1815,11 @@ class PaxosManager:
                 callback(request_id, response)
             return None
         if cached_hit:
-            if self.tracer.enabled:
+            if self.tracer.enabled or trace_ctx is not None:
                 self.tracer.note(request_id, "respond-cached", name=name,
-                                 node=self.my_id)
+                                 node=self.my_id,
+                                 force=trace_ctx is not None,
+                                 **self._tc_detail(trace_ctx))
             if callback:
                 callback(request_id, cached_response)
             return None
@@ -1773,7 +1842,9 @@ class PaxosManager:
 
         ``items``: [(name, value, request_id, callback)] — an optional
         5th element overrides the entry replica per item (forwarded
-        proposals keep their original entry).  Returns
+        proposals keep their original entry) and an optional 6th element
+        is the item's cross-node trace context (tid, origin, hop).
+        Returns
         [(request_id, outcome, response)]: "queued", "cached" (callback
         already fired with the response), "inflight" (original still
         live; callback re-registered), or "unknown" (name not here).
@@ -1812,6 +1883,7 @@ class PaxosManager:
                     item[4] if len(item) > 4 and item[4] is not None
                     else default_entry
                 )
+                tc = item[5] if len(item) > 5 else None
                 row = names.get(name)
                 if row is None:
                     results.append((rid, "unknown", None))
@@ -1850,10 +1922,12 @@ class PaxosManager:
                 self.demand_counts[name] = self.demand_counts.get(name, 0) + 1
                 self.demand_backlog += 1
                 results.append((rid, "queued", None))
-                if tr_on:
+                self._install_trace_locked(rid, tc)
+                if tr_on or tc is not None:
                     self.tracer.note(
                         rid, "propose", name=name, node=self.my_id,
                         vid=vid, row=row, entry=entry, batch=True,
+                        force=tc is not None, **self._tc_detail(tc),
                     )
         for cb, rid, resp in fired:
             cb(rid, resp)
@@ -1928,6 +2002,20 @@ class PaxosManager:
                 DelayProfiler.update_count(
                     "t_log_payloads", time.monotonic() - t_lp
                 )
+            tcs = body.get("tc")
+            if tcs:
+                # trace contexts ride the payload gossip so every replica
+                # can stamp its decide/execute events with the trace id
+                # (gossip=False: the origin already broadcast to all)
+                for rid_s, tc in tcs.items():
+                    try:
+                        self._install_trace_locked(
+                            int(rid_s),
+                            (int(tc[0]), int(tc[1]), int(tc[2])),
+                            gossip=False,
+                        )
+                    except (TypeError, ValueError, IndexError):
+                        continue
             ae = body.get("app_exec")
             if ae is not None:
                 rid, cursors = ae
@@ -1956,17 +2044,21 @@ class PaxosManager:
                 # executing in the new epoch diverges the RSM (chaos
                 # soak); genuine client requests retransmit
                 return
-            if self.tracer.enabled:
+            tc = body.get("tc")
+            tc = None if not tc else (int(tc[0]), int(tc[1]), int(tc[2]))
+            if self.tracer.enabled or tc is not None:
                 self.tracer.note(
                     body.get("request_id"), "forward-in",
                     name=body["name"], node=self.my_id,
                     entry=body.get("entry"),
+                    force=tc is not None, **self._tc_detail(tc),
                 )
             self.propose(
                 body["name"], body["value"],
                 stop=body.get("stop", False),
                 request_id=body.get("request_id"),
                 entry_replica=body.get("entry", None),
+                trace_ctx=tc,
             )
         elif kind == "forward_batch":
             # a peer forwards a whole queue run (one frame, many
@@ -1978,10 +2070,23 @@ class PaxosManager:
             if self.current_epoch(body["name"]) != int(body["epoch"]):
                 return
             name = body["name"]
-            if self.tracer.enabled:
+            tcs = body.get("tc") or {}
+
+            def _tc_of(rid):
+                tc = tcs.get(str(rid))
+                return None if not tc else (
+                    int(tc[0]), int(tc[1]), int(tc[2])
+                )
+
+            tr_on = self.tracer.enabled
+            if tr_on or tcs:
                 for rid, entry, _v, _s in body["reqs"]:
-                    self.tracer.note(rid, "forward-in", name=name,
-                                     node=self.my_id, entry=entry)
+                    tc = _tc_of(rid)
+                    if tr_on or tc is not None:
+                        self.tracer.note(rid, "forward-in", name=name,
+                                         node=self.my_id, entry=entry,
+                                         force=tc is not None,
+                                         **self._tc_detail(tc))
             items = []
             for rid, entry, value, stop in body["reqs"]:
                 if stop:
@@ -1990,10 +2095,11 @@ class PaxosManager:
                         items = []
                     self.propose(
                         name, value, stop=True, request_id=rid,
-                        entry_replica=entry,
+                        entry_replica=entry, trace_ctx=_tc_of(rid),
                     )
                 else:
-                    items.append((name, value, rid, None, entry))
+                    items.append((name, value, rid, None, entry,
+                                  _tc_of(rid)))
             if items:
                 self.propose_batch(items)
         elif kind == "state_request":  # checkpoint-transfer pull
@@ -2179,15 +2285,29 @@ class PaxosManager:
                     self.vid_meta.pop(vid, None)
                     self.vid_scope.pop(vid, None)
                 if reqs:
-                    if self.tracer.enabled:
-                        for rid, _e, _v, _s in reqs:
+                    # traced requests carry their context to the
+                    # coordinator, hop-incremented (one process boundary)
+                    fwd_tc = {}
+                    tcm = self.trace_ctx
+                    for rid, _e, _v, _s in reqs:
+                        tc = tcm.get(rid) if tcm else None
+                        if tc is not None:
+                            fwd_tc[str(rid)] = [tc[0], tc[1], tc[2] + 1]
+                        if self.tracer.enabled or tc is not None:
                             self.tracer.note(
                                 rid, "forward-out", name=name,
                                 node=self.my_id, to=coord,
+                                force=tc is not None,
+                                **self._tc_detail(tc),
                             )
-                    self.forward_out.append((coord, "forward_batch", {
+                    body = {
                         "name": name, "epoch": epoch_now, "reqs": reqs,
-                    }))
+                    }
+                    if fwd_tc:
+                        body["tc"] = fwd_tc
+                    self.forward_out.append(
+                        (coord, "forward_batch", body)
+                    )
                 vids.clear()
                 continue
             if self.batching_enabled and len(vids) > max(
@@ -2445,21 +2565,34 @@ class PaxosManager:
             mx.count("requests_admitted", n_admit)
         if len(pre_g):
             mx.count("preempts", len(pre_g))
+        flips = rises = 0
         if out_np.bal_new.any():
             # coordinator flips: `bal` is only pulled host-side on the
             # rare ticks where a promised ballot rose (elections), and
             # only the risen rows are compared against the cached view
             pg_m = np.nonzero(out_np.bal_new)[0]
-            new_coord = ballot_coord(self._np("bal")[pg_m]).astype(np.int32)
+            bal_host = self._np("bal")
+            self._bal_host = bal_host.copy()
+            new_coord = ballot_coord(bal_host[pg_m]).astype(np.int32)
             flips = int((new_coord != self._coord_cache[pg_m]).sum())
             if flips:
                 mx.count("coordinator_flips", flips)
             self._coord_cache[pg_m] = new_coord
-            mx.count("ballot_rises", len(pg_m))
+            rises = len(pg_m)
+            mx.count("ballot_rises", rises)
         mx.gauge("frontier_stall_groups", len(self._payload_blocked))
         mx.gauge("inflight_requests", len(self.inflight))
         mx.gauge("arena_payloads", len(self.arena))
         mx.observe("engine_step_s", self.last_engine_step_s)
+        # flight recorder: the per-step summary ring (always on; skips
+        # pure-idle ticks internally so the ring spans real history)
+        self.flight.record_step(
+            tick=self._tick_no, admitted=n_admit, decided=n_dec,
+            preempts=len(pre_g), coordinator_flips=flips,
+            ballot_rises=rises,
+            frontier_stalls=len(self._payload_blocked),
+            inflight=len(self.inflight),
+        )
         # payload-retention watermark: min APP-execution cursor over all
         # group members (device frontiers can run ahead of payload-gated
         # app execution — GC'ing on them would strand a parked peer).
@@ -2554,6 +2687,14 @@ class PaxosManager:
                 int(g): int(self.app_exec_slot[g]) for g in dirty
             }),
         }
+        if self._tc_gossip:
+            # sampled requests' trace contexts ride the payloads frame
+            # once (drain): peers stamp their decide/execute events with
+            # the shared trace id
+            tc_out, self._tc_gossip = self._tc_gossip, {}
+            host_delta["tc"] = {
+                str(rid): list(tc) for rid, tc in tc_out.items()
+            }
         return host_delta
 
     # ------------------------------------------------------------------
@@ -2578,25 +2719,41 @@ class PaxosManager:
         if len(committed):
             self.row_activity[committed] = time.time()
         tr = self.tracer
+        tcm = self.trace_ctx
+        # ballot attribution for decide events + the flight recorder's
+        # decided ring comes from the rise-tick host view (_bal_host) —
+        # pulling `bal` from the device per commit tick costs a sync
+        # that measurably perturbs soak timing
+        bal_np = self._bal_host
         for g in committed:
             base = int(out_np.exec_base[g])
+            bal_g = int(bal_np[g])
             pend = self.pending_exec.setdefault(int(g), {})
             for o in range(int(out_np.n_committed[g])):
                 vid = int(out_np.exec_vid[g, o])
                 pend[base + o] = vid
-                if tr.enabled and vid != 0:
-                    meta = self.vid_meta.get(vid)
+                self.flight.record_decided(int(g), base + o, bal_g, vid)
+                if vid == 0:
+                    continue
+                meta = self.vid_meta.get(vid)
+                key = vid if meta is None or meta[1] == -1 else meta[1]
+                tc = tcm.get(key) if tcm else None
+                if tr.enabled or tc is not None:
                     tr.note(
-                        vid if meta is None or meta[1] == -1 else meta[1],
-                        "decide", name=self.row_name.get(int(g)),
-                        node=self.my_id, row=int(g), slot=base + o, vid=vid,
+                        key, "decide", name=self.row_name.get(int(g)),
+                        node=self.my_id, row=int(g), slot=base + o,
+                        vid=vid, ballot=bal_g,
+                        force=tc is not None, **self._tc_detail(tc),
                     )
         t_exec = time.monotonic()
         missing = self._drain_pending_exec()
         DelayProfiler.update_delay("app_execute", t_exec)
-        DelayProfiler.update_count(
-            "t_app_execute", time.monotonic() - t_exec
-        )
+        dt_exec = time.monotonic() - t_exec
+        DelayProfiler.update_count("t_app_execute", dt_exec)
+        if len(committed):
+            # per-phase latency distribution (SLO surface): the decided-
+            # slot execution leg of a tick, exported via /metrics + stats
+            self.metrics.observe("phase_execute_s", dt_exec)
         if missing:
             self.forward_out.append(
                 (-1, "need_payloads", SyncDecisionsPacket(
@@ -2750,9 +2907,13 @@ class PaxosManager:
                 req = SlimRequest(nm, request_id, value)
                 self._app_execute_retrying(req, do_not_reply=(entry != my))
                 self.total_executed += 1
-                if tr_on:
+                tc = self.trace_ctx.get(request_id) \
+                    if self.trace_ctx else None
+                if tr_on or tc is not None:
                     self.tracer.note(request_id, "execute", name=nm,
-                                     node=my, row=g, slot=slot, batch=True)
+                                     node=my, row=g, slot=slot, batch=True,
+                                     force=tc is not None,
+                                     **self._tc_detail(tc))
                 self.inflight.pop(request_id, None)
                 response = req.response_value
                 rc[request_id] = (now, response, nm)
@@ -2786,10 +2947,12 @@ class PaxosManager:
         )
         self._app_execute_retrying(req, do_not_reply=(entry != self.my_id))
         self.total_executed += 1
-        if self.tracer.enabled:
+        tc = self.trace_ctx.get(request_id) if self.trace_ctx else None
+        if self.tracer.enabled or tc is not None:
             self.tracer.note(request_id, "execute", name=name or "",
                              node=self.my_id, row=g, slot=slot,
-                             stop=bool(vid & STOP_BIT))
+                             stop=bool(vid & STOP_BIT),
+                             force=tc is not None, **self._tc_detail(tc))
         self._slots_since_ckpt += 1
         self.inflight.pop(request_id, None)
         response = getattr(req, "response_value", None)

@@ -96,6 +96,8 @@ def _declare_journal(lib: ctypes.CDLL) -> bool:
 
 def _declare_codec(lib: ctypes.CDLL) -> bool:
     u8p = ctypes.POINTER(ctypes.c_uint8)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
     pp = ctypes.POINTER(ctypes.c_char_p)
     lib.gpc_req_index.argtypes = [
         ctypes.c_char_p, ctypes.c_uint64,
@@ -109,23 +111,40 @@ def _declare_codec(lib: ctypes.CDLL) -> bool:
     lib.gpc_resp_index.restype = ctypes.c_int64
     lib.gpc_pack_req.argtypes = [
         u8p, ctypes.c_uint64, ctypes.c_int32, ctypes.c_uint32,
-        ctypes.POINTER(ctypes.c_uint64), u8p,
+        u64p, u8p,
         pp, ctypes.POINTER(ctypes.c_uint16),
         pp, ctypes.POINTER(ctypes.c_uint32),
+        u64p, i32p, u8p,  # trace context: tids, origins, hops
     ]
     lib.gpc_pack_req.restype = ctypes.c_int64
     lib.gpc_pack_resp.argtypes = [
         u8p, ctypes.c_uint64, ctypes.c_int32, ctypes.c_uint32,
-        ctypes.POINTER(ctypes.c_uint64), u8p, u8p,
+        u64p, u8p, u8p,
         pp, ctypes.POINTER(ctypes.c_uint16),
         pp, ctypes.POINTER(ctypes.c_uint32),
+        u64p, i32p, u8p,  # trace context: tids, origins, hops
     ]
     lib.gpc_pack_resp.restype = ctypes.c_int64
     # self-check: an empty batch must index back to zero items — a
-    # mis-built library must never reach the wire
+    # mis-built (or STALE pre-trace-ABI) library must never reach the
+    # wire.  The second probe indexes a one-item traced frame: an old
+    # library rejects the trace tail as trailing garbage and is refused
+    # here, forcing the Python fallback instead of wire corruption.
     hdr = b"R" + (0).to_bytes(4, "little") + (0).to_bytes(4, "little")
-    out = (ctypes.c_int64 * 6)()
-    return lib.gpc_req_index(hdr, len(hdr), out, 1) == 0
+    out = (ctypes.c_int64 * 9)()
+    if lib.gpc_req_index(hdr, len(hdr), out, 1) != 0:
+        return False
+    traced = (
+        b"R" + (0).to_bytes(4, "little") + (1).to_bytes(4, "little")
+        + (7).to_bytes(8, "little") + bytes([0x02])
+        + (1).to_bytes(2, "little") + (0).to_bytes(4, "little") + b"n"
+        + (9).to_bytes(8, "little") + (3).to_bytes(4, "little") + bytes([1])
+    )
+    out2 = (ctypes.c_int64 * 9)()
+    return (
+        lib.gpc_req_index(traced, len(traced), out2, 1) == 1
+        and out2[6] == 9 and out2[7] == 3 and out2[8] == 1
+    )
 
 
 def journal_lib() -> Optional[ctypes.CDLL]:

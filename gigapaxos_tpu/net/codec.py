@@ -30,6 +30,40 @@ from ..ops.engine import Blob, EngineConfig, _leaf_shapes, blob_vec_len
 
 _BHDR = struct.Struct(">cIQ")  # kind, sender, tick
 
+# cross-node trace context (Dapper-style, obs/reqtrace.py): an OPTIONAL
+# ``"tc": [trace_id, origin_node, hop]`` field on J-frame request bodies
+# (client_request[_batch] items, forward/forward_batch, payload gossip).
+# Absent = untraced; bodies without it are byte-identical to the
+# pre-trace wire format.  The binary R/S frames carry the same triple in
+# a fixed 13-byte layout (net/hot_codec.py).
+TRACE_KEY = "tc"
+
+
+def attach_trace(body: Dict, tc) -> Dict:
+    """Stamp a trace context onto a request body (no-op when None)."""
+    if tc is not None:
+        body[TRACE_KEY] = [int(tc[0]), int(tc[1]), int(tc[2])]
+    return body
+
+
+def extract_trace(body: Dict):
+    """-> (trace_id, origin, hop) or None; malformed contexts drop (a
+    trace field must never break request handling)."""
+    tc = body.get(TRACE_KEY)
+    if not tc:
+        return None
+    try:
+        return (int(tc[0]), int(tc[1]), int(tc[2]))
+    except (TypeError, ValueError, IndexError, KeyError):
+        return None
+
+
+def bump_hop(tc):
+    """The per-process-boundary hop increment (forwards re-stamp with
+    this so the merged timeline orders hops causally even under clock
+    skew)."""
+    return None if tc is None else (tc[0], tc[1], tc[2] + 1)
+
 
 def encode_json(kind: str, sender: int, body: Dict) -> bytes:
     env = {"k": kind, "s": sender, "b": body}

@@ -24,6 +24,8 @@ tracing is gated.
 
 from __future__ import annotations
 
+import gc
+import os
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -186,3 +188,39 @@ class MetricsRegistry:
             lines.append(f"gp_{k}_count{tag} {h['count']}")
             lines.append(f"gp_{k}_sum{tag} {self._num(h['sum'])}")
         return "\n".join(lines) + "\n"
+
+
+def collect_process_gauges(reg: MetricsRegistry) -> None:
+    """Refresh per-PROCESS resource gauges (RSS, open fds, GC
+    collections, thread count) into ``reg``.  Multi-hour soaks and
+    ``SERVING_WORKERS`` parents need per-process drift visible on
+    /metrics — a slow fd or RSS leak is otherwise invisible until the
+    box dies.  Called at the stats-line cadence (server loop), never per
+    request; every probe degrades silently on platforms without /proc."""
+    try:
+        with open("/proc/self/statm") as f:
+            rss_pages = int(f.read().split()[1])
+        reg.gauge("process_rss_bytes",
+                  rss_pages * os.sysconf("SC_PAGE_SIZE"))
+    except (OSError, ValueError, IndexError):
+        try:
+            import resource
+
+            reg.gauge(
+                "process_rss_bytes",
+                resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024,
+            )
+        except (ImportError, OSError, ValueError):
+            pass
+    try:
+        reg.gauge("process_open_fds", len(os.listdir("/proc/self/fd")))
+    except OSError:
+        pass
+    try:
+        reg.gauge(
+            "process_gc_collections",
+            sum(s.get("collections", 0) for s in gc.get_stats()),
+        )
+    except Exception:
+        pass
+    reg.gauge("process_threads", threading.active_count())

@@ -22,18 +22,33 @@ less cheap.  When disabled the tracer records nothing and allocates
 nothing.  ``enabled`` defaults from ``GP_TRACE=1`` or a DEBUG-level
 ``gp.trace`` logger (``GP_LOG=trace:DEBUG``) at construction; soaks and
 tests flip the attribute directly.
+
+Cross-node tracing (the Dapper half the reference never had): a request
+sampled at its ORIGIN (``GP_TRACE_SAMPLE``, a probability) carries a
+compact trace context ``(trace_id, origin, hop)`` on every wire hop —
+client frame, coordinator forward, payload gossip — and every node on
+the path records its events for that request REGARDLESS of its local
+``enabled`` flag (``note(..., force=True)``): sampling is decided once,
+where the request is born, and the whole cluster honors it.  Timestamps
+are WALL-clock (``time.time()``) so per-node dumps merge into one causal
+cross-node timeline (``obs/tracemerge.py``); clock skew between hosts is
+clamped at merge time, exactly as Dapper does.
 """
 
 from __future__ import annotations
 
 import logging
 import os
+import random
 import threading
 import time
 from collections import OrderedDict, deque
 from typing import Dict, List, Optional, Tuple
 
 _TRUE = frozenset(("1", "true", "yes", "on"))
+
+# trace context = (trace_id, origin node, hop counter)
+TraceCtx = Tuple[int, int, int]
 
 
 def trace_enabled() -> bool:
@@ -43,6 +58,33 @@ def trace_enabled() -> bool:
     from .gplog import get_logger
 
     return get_logger("trace").isEnabledFor(logging.DEBUG)
+
+
+def trace_sample_rate() -> float:
+    """``GP_TRACE_SAMPLE`` env: probability in [0, 1] that a request
+    minted at this process carries a trace context.  0 (default) = no
+    sampling; 1 = trace everything.  Cheap enough to leave >0 in
+    production — only sampled requests pay any tracing cost downstream."""
+    raw = os.environ.get("GP_TRACE_SAMPLE", "").strip()
+    if not raw:
+        return 0.0
+    try:
+        return min(1.0, max(0.0, float(raw)))
+    except ValueError:
+        return 0.0
+
+
+def maybe_mint_trace(
+    origin: int, rate: Optional[float] = None
+) -> Optional[TraceCtx]:
+    """Sampling decision + context mint at a request's origin: returns
+    ``(trace_id, origin, 0)`` with probability ``rate`` (default: the
+    ``GP_TRACE_SAMPLE`` env), else None.  Trace ids are random 63-bit
+    and never 0, so ``tid`` in an event detail is always truthy."""
+    r = trace_sample_rate() if rate is None else rate
+    if r <= 0.0 or (r < 1.0 and random.random() >= r):
+        return None
+    return (random.getrandbits(63) | 1, int(origin), 0)
 
 
 class RequestTracer:
@@ -72,12 +114,17 @@ class RequestTracer:
 
     # ---- recording (hot path when enabled, no-op when not) -----------
     def note(self, key, event: str, name: Optional[str] = None,
-             **detail) -> None:
+             force: bool = False, **detail) -> None:
         """Append one event to ``key``'s timeline.  ``name`` additionally
-        indexes the key under that service name for dump_name()."""
-        if not self.enabled:
+        indexes the key under that service name for dump_name().
+        ``force=True`` records even when the tracer is disabled — the
+        cross-node sampling contract: a request that arrived CARRYING a
+        trace context was sampled at its origin, and every node on its
+        path owes it events (callers pass ``force=tc is not None``).
+        Timestamps are wall-clock so per-node rings merge causally."""
+        if not (self.enabled or force):
             return
-        t = time.monotonic()
+        t = time.time()
         with self._lock:
             timeline = self._events.get(key)
             if timeline is None:
@@ -129,6 +176,29 @@ class RequestTracer:
                 + (f" [{tail}]" if tail else "")
             )
         return "\n".join(lines)
+
+    def export(self, keys=None, name: Optional[str] = None,
+               limit: int = 256) -> Dict[str, List]:
+        """JSON-safe dump of (a slice of) the ring for the ``trace_dump``
+        admin op and the cross-node merge: ``{str(key): [[t_wall, event,
+        detail], ...]}``.  ``keys`` selects specific request keys;
+        ``name`` selects that service name's recently traced keys; with
+        neither, the NEWEST ``limit`` keys ship (the ring is insertion-
+        ordered, so the tail is the recent traffic)."""
+        with self._lock:
+            if keys is None:
+                if name is not None:
+                    keys = list(self._by_name.get(name, ()))
+                else:
+                    keys = list(self._events.keys())[-max(0, int(limit)):]
+            out: Dict[str, List] = {}
+            for k in keys:
+                evs = self._events.get(k)
+                if evs:
+                    out[str(k)] = [
+                        [t, ev, dict(detail)] for t, ev, detail in evs
+                    ]
+        return out
 
     def dump_name(self, name: str, limit: int = 4) -> str:
         """Timelines of the most recent ``limit`` distinct keys traced

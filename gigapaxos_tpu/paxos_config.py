@@ -84,11 +84,21 @@ class PC(FlagEnum):
     TICK_INTERVAL_S = 0.01               # server drive-loop cadence
     RESPONSE_CACHE_TTL_S = 60.0          # exactly-once retransmit cache TTL
 
-    # ---- observability (obs/: gplog + reqtrace + metrics) -------------
+    # ---- observability (obs/: gplog + reqtrace + metrics + flight) ----
     # cadence of the server's INFO stats line (engine counters +
     # DelayProfiler); the line only renders when gp.server is at INFO
     # (GP_LOG=server:INFO), so the default deployment pays a level check
     STATS_LOG_PERIOD_S = 10.0
+    # black-box flight recorder (obs/flight.py; always on): ring sizes
+    # for the per-step engine summaries and the last-K decided
+    # (group, slot, ballot, vid) entries, and where dumps land on a
+    # SoakDivergence / tick-loop exception / `flightdump` admin op.
+    # (Per-request trace SAMPLING is the GP_TRACE_SAMPLE env var, not a
+    # flag: the decision is made in clients, possibly outside any
+    # properties file.)
+    FLIGHT_STEPS = 512
+    FLIGHT_DECIDED = 1024
+    FLIGHT_DIR = "flight_dumps"
 
     # ---- recovery plane (new; restart-to-serving SLO) ------------------
     # checkpoint sharding: >1 splits every snapshot into this many

@@ -34,7 +34,9 @@ from .net.codec import (
     decode_kind,
     encode_blob_vec,
     encode_json,
+    extract_trace,
 )
+from .obs.metrics import collect_process_gauges
 from .net.node_config import NodeConfig
 from .net.transport import MessageTransport
 from .obs import gplog
@@ -409,15 +411,24 @@ class PaxosServer:
             bufs, self._resp_buf = self._resp_buf, {}
         t0 = time.monotonic()
         tr = self.tracer
-        mx = self.manager.metrics
+        m = self.manager
+        mx = m.metrics
+        tcm = m.trace_ctx
         n_items = 0
         for reply, items, binary in bufs.values():
-            if tr.enabled:
-                for item in items:
+            for item in items:
+                rid = item.get("request_id")
+                tc = tcm.get(rid) if tcm else None
+                if tc is not None:
+                    # the context rides the response (S trace tail /
+                    # JSON "tc") so the client can close the loop
+                    item.setdefault("tc", list(tc))
+                if tr.enabled or tc is not None:
                     tr.note(
-                        item.get("request_id"), "respond-flush",
+                        rid, "respond-flush",
                         name=item.get("name"), node=self.my_id,
                         error=item.get("error"),
+                        force=tc is not None, **m._tc_detail(tc),
                     )
             n_items += len(items)
             mx.observe("flush_batch_size", len(items),
@@ -435,7 +446,9 @@ class PaxosServer:
         if n_items:
             mx.count("responses_flushed", n_items)
             mx.count("response_frames_sent", len(bufs))
-        DelayProfiler.update_count("t_flush", time.monotonic() - t0)
+        dt = time.monotonic() - t0
+        DelayProfiler.update_count("t_flush", dt)
+        mx.observe("phase_flush_s", dt)
 
     def _on_client_request(self, body: Dict, reply) -> None:
         t0 = time.monotonic()
@@ -459,38 +472,42 @@ class PaxosServer:
         ) is True
 
     def _on_client_batch(self, reqs, reply) -> None:
-        """JSON batched-frame ingress: normalize to item tuples and take
-        the shared path."""
-        self._on_client_items(
-            [
-                (int(sub["request_id"]), sub["name"],
-                 sub.get("value", ""), bool(sub.get("stop")))
-                for sub in reqs
-            ],
-            reply, binary=False,
-        )
+        """JSON batched-frame ingress: normalize to item tuples (traced
+        items become 5-tuples, like the binary decode's) and take the
+        shared path."""
+        items = []
+        for sub in reqs:
+            base = (int(sub["request_id"]), sub["name"],
+                    sub.get("value", ""), bool(sub.get("stop")))
+            tc = extract_trace(sub)
+            items.append(base + (tc,) if tc is not None else base)
+        self._on_client_items(items, reply, binary=False)
 
     def _on_client_items(self, reqs, reply, binary: bool = False) -> None:
         """Batched ingress (both wire formats): one propose_batch call
         for the whole frame (stops, local reads, and overload shedding
         peel off to their own paths; everything else amortizes the
         lock/clock per frame).  ``reqs``: [(request_id, name, value,
-        stop)]."""
+        stop)] — traced items are 5-tuples carrying (tid, origin, hop)."""
         t0 = time.monotonic()
         m = self.manager
         tr = self.tracer
         overloaded = m.overloaded()
         items = []
-        for request_id, name, value, stop in reqs:
+        for item in reqs:
+            request_id, name, value, stop = item[:4]
+            tc = item[4] if len(item) > 4 else None
             if stop:
-                self._on_client_request_inner({
-                    "request_id": request_id, "name": name,
-                    "value": value, "stop": True,
-                }, reply)
+                body = {"request_id": request_id, "name": name,
+                        "value": value, "stop": True}
+                if tc is not None:
+                    body["tc"] = list(tc)
+                self._on_client_request_inner(body, reply)
                 continue
-            if tr.enabled:
+            if tr.enabled or tc is not None:
                 tr.note(request_id, "recv", name=name, node=self.my_id,
-                        batch=True)
+                        batch=True, force=tc is not None,
+                        **m._tc_detail(tc))
 
             def cb(rid, response, _name=name):
                 self._buffer_response(reply, {
@@ -505,10 +522,10 @@ class PaxosServer:
                     "name": name, "error": "overload",
                 }, binary)
                 continue
-            items.append((name, value, request_id, cb))
+            items.append((name, value, request_id, cb, None, tc))
         if items:
             results = m.propose_batch(items)
-            for (name, _v, _r, _cb), (rid, outcome, _resp) in zip(
+            for (name, _v, _r, _cb, _e, _tc), (rid, outcome, _resp) in zip(
                 items, results
             ):
                 if outcome == "unknown":
@@ -523,14 +540,19 @@ class PaxosServer:
                         "request_id": rid, "response": None,
                         "name": name, "error": "exhausted",
                     }, binary)
-        DelayProfiler.update_count("t_ingress", time.monotonic() - t0)
+        dt = time.monotonic() - t0
+        DelayProfiler.update_count("t_ingress", dt)
+        m.metrics.observe("phase_ingress_s", dt)
 
     def _on_client_request_inner(self, body: Dict, reply) -> None:
         request_id = int(body["request_id"])
         name = body["name"]
-        if self.tracer.enabled:
+        tc = extract_trace(body)
+        if self.tracer.enabled or tc is not None:
             self.tracer.note(request_id, "recv", name=name, node=self.my_id,
-                             stop=bool(body.get("stop", False)))
+                             stop=bool(body.get("stop", False)),
+                             force=tc is not None,
+                             **self.manager._tc_detail(tc))
         if not body.get("stop") and self._maybe_local_read(
             name, body.get("value", ""), request_id,
             lambda rid, response: self._buffer_response(reply, {
@@ -557,7 +579,7 @@ class PaxosServer:
         vid = self.manager.propose(
             name, body.get("value", ""),
             callback=cb, stop=bool(body.get("stop", False)),
-            request_id=request_id,
+            request_id=request_id, trace_ctx=tc,
         )
         if vid is None and request_id not in self.manager.response_cache \
                 and self.manager.names.get(name) is None:
@@ -636,6 +658,35 @@ class PaxosServer:
             if layer:
                 out["layer"] = layer
             reply(encode_json("admin_response", self.my_id, out))
+        elif op == "trace_dump":
+            # stream this node's trace ring (or a slice of it) for the
+            # cross-node merge (scripts/gp_trace.py): per-key event
+            # lists with WALL-clock stamps, mergeable across nodes
+            tr = self.tracer
+            keys = None
+            if body.get("rid") is not None:
+                keys = [int(body["rid"])]
+            reply(encode_json("admin_response", self.my_id, {
+                "op": op, "name": body.get("name"), "ok": True,
+                "node": self.my_id, "enabled": tr.enabled,
+                "events": tr.export(
+                    keys=keys, name=body.get("name") or None,
+                    limit=int(body.get("limit", 256)),
+                ),
+            }))
+        elif op == "flightdump":
+            # the black box, on demand: dump the engine-history rings to
+            # disk and answer with the path (plus ring occupancy, so an
+            # operator can see at a glance whether history was captured)
+            fl = self.manager.flight
+            path = fl.dump(reason=str(body.get("reason") or "admin"))
+            snap = fl.snapshot()
+            reply(encode_json("admin_response", self.my_id, {
+                "op": op, "name": body.get("name"), "ok": path is not None,
+                "node": self.my_id, "path": path,
+                "steps": len(snap["steps"]),
+                "decided": len(snap["decided"]),
+            }))
         else:
             # an unknown op must still ANSWER: silence leaves the
             # client's admin waiter parked until its timeout
@@ -657,6 +708,19 @@ class PaxosServer:
                 self._maybe_stats_line()
             except Exception:
                 self.log.exception("tick loop error (loop continues)")
+                # black box: a tick-loop exception is exactly the moment
+                # the engine's recent history matters — dump once per
+                # node (the loop continues; a persistent bug must not
+                # write a dump per tick)
+                try:
+                    path = self.manager.flight.dump(
+                        reason="tick-exception", once=True
+                    )
+                    if path:
+                        self.log.warning("flight recorder dumped to %s",
+                                         path)
+                except Exception:
+                    pass  # the recorder must never take the loop down
             dt = time.perf_counter() - t0
             interval = self.tick_interval
             backlog = self._batching and self.manager.has_backlog()
@@ -866,7 +930,9 @@ class PaxosServer:
             frame = encode_json("payloads", self.my_id, pub["delta"])
             for r in peers:
                 self.transport.send_to_id(r, frame)
-        DelayProfiler.update_count("t_publish", time.monotonic() - t_pub)
+        dt_pub = time.monotonic() - t_pub
+        DelayProfiler.update_count("t_publish", dt_pub)
+        m.metrics.observe("phase_publish_s", dt_pub)
         for dst, k, body in pub["fwd"]:
             frame = encode_json(k, self.my_id, body)
             # send_frame_to_id streams oversize frames (a multi-MB
@@ -888,6 +954,11 @@ class PaxosServer:
         if now - self._last_stats_line < self._stats_period_s:
             return
         self._last_stats_line = now
+        # per-process resource gauges (RSS / fds / GC / threads) refresh
+        # at the stats cadence: slow leaks across a multi-hour soak (or a
+        # SERVING_WORKERS parent) become visible on /metrics and the
+        # stats op long before the box dies
+        collect_process_gauges(self.manager.metrics)
         if self.log.isEnabledFor(logging.INFO):
             self.log.info(
                 "stats tick=%d %s %s", self._tick,

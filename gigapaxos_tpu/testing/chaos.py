@@ -42,11 +42,38 @@ class SoakDivergence(AssertionError):
         self.diag = diag or {}
 
 
+def _flight_dump_all(c: ReconfigurableCluster, reason: str) -> List[str]:
+    """Dump every AR member's flight recorder (obs/flight.py) for a
+    divergence post-mortem; returns the on-disk paths."""
+    paths = []
+    for m in c.ars.managers:
+        try:
+            p = m.flight.dump(reason=reason)
+        except Exception:
+            p = None
+        if p:
+            paths.append(p)
+    return paths
+
+
+def _divergence(c: ReconfigurableCluster, msg: str,
+                diag: Optional[Dict] = None) -> SoakDivergence:
+    """Build a SoakDivergence WITH the black box attached: every
+    member's flight-recorder rings land on disk and the paths ride the
+    failure diagnostics — the strict-sweep contract that every residual
+    breach is post-mortemable from the artifact alone."""
+    diag = dict(diag or {})
+    diag["flight_dumps"] = _flight_dump_all(c, reason="divergence")
+    return SoakDivergence(msg, diag)
+
+
 def _name_diag(c: ReconfigurableCluster, nm: str, actives: List[int]) -> Dict:
     """Per-member engine + dedup evidence for one name, plus (when the
-    per-request tracer is on — run_soak enables it) each member's recent
-    request timelines for the name and the RCs' epoch-op timeline, so a
-    divergence message carries the requests' actual journeys."""
+    per-request tracer is on — run_soak enables it) the MERGED cross-
+    member timeline of the name's recent requests (one causal story per
+    request, every member's hops interleaved — obs/tracemerge.py) and
+    the RCs' epoch-op timeline, so a divergence message carries the
+    requests' actual journeys."""
     out: Dict = {}
     for a in actives:
         m = c.ars.managers[a]
@@ -74,9 +101,17 @@ def _name_diag(c: ReconfigurableCluster, nm: str, actives: List[int]) -> Dict:
         ent["old_epochs"] = sorted(
             e for (n, e) in m.old_epochs if n == nm
         )
-        if m.tracer.enabled:
-            ent["trace"] = m.tracer.dump_name(nm)
         out[a] = ent
+    # ONE merged cross-member timeline instead of per-member fragments:
+    # the same request's recv/propose/forward/decide/execute hops from
+    # every member interleave causally with per-hop latencies
+    from ..obs.tracemerge import merge_name_timeline
+
+    merged = merge_name_timeline(
+        {a: c.ars.managers[a].tracer for a in actives}, nm,
+    )
+    if merged:
+        out["merged_trace"] = merged
     rc_traces = {
         rc.my_id: rc.tracer.dump(f"epoch:{nm}")
         for rc in c.reconfigurators
@@ -110,7 +145,8 @@ def probe_exactly_once(c: ReconfigurableCluster, names) -> None:
         for (ver, fr), members in groups.items():
             states = {s for _, s in members}
             if len(states) > 1:
-                raise SoakDivergence(
+                raise _divergence(
+                    c,
                     "exactly-once breach (transient): caught-up members at "
                     "one (epoch, frontier) disagree on app state",
                     {"name": nm, "epoch": ver, "frontier": fr,
@@ -158,7 +194,8 @@ def settle_and_audit(c: ReconfigurableCluster, names, step,
             if r is not None and not r.deleted
             and r.state not in (RCState.READY, RCState.PAUSED)
         }
-        raise SoakDivergence(
+        raise _divergence(
+            c,
             "records did not settle",
             {
                 "records": {
@@ -180,8 +217,8 @@ def settle_and_audit(c: ReconfigurableCluster, names, step,
         views = [rc.rc_app.get_record(nm) for rc in c.reconfigurators]
         datas = [None if v is None else v.to_json() for v in views]
         if not all(d == datas[0] for d in datas):
-            raise SoakDivergence("RC record disagreement",
-                                 {"name": nm, "views": datas})
+            raise _divergence(c, "RC record disagreement",
+                              {"name": nm, "views": datas})
 
     for nm, rec in recs.items():
         if rec is None or rec.deleted:
@@ -202,8 +239,8 @@ def settle_and_audit(c: ReconfigurableCluster, names, step,
                 step()
             for m in c.ars.managers:
                 if m.names.get(nm) is not None:
-                    raise SoakDivergence(
-                        "name lingers post-delete",
+                    raise _divergence(
+                        c, "name lingers post-delete",
                         {"name": nm, "member": m.my_id},
                     )
             continue
@@ -211,8 +248,9 @@ def settle_and_audit(c: ReconfigurableCluster, names, step,
             held = [m for m in c.ars.managers
                     if (nm, rec.epoch) in m.paused]
             if not held:
-                raise SoakDivergence(
-                    "paused with no pause records anywhere", {"name": nm}
+                raise _divergence(
+                    c, "paused with no pause records anywhere",
+                    {"name": nm},
                 )
             continue
         # READY: actives host the name at ONE aligned row and agree.
@@ -236,7 +274,8 @@ def settle_and_audit(c: ReconfigurableCluster, names, step,
         if rec is None or rec.deleted or rec.state is not RCState.READY:
             continue
         if rows != {rec.row}:
-            raise SoakDivergence(
+            raise _divergence(
+                c,
                 "READY actives not aligned at record row",
                 {"name": nm, "want_row": rec.row, "rows": sorted(
                     (a, c.ars.managers[a].names.get(nm))
@@ -265,7 +304,8 @@ def settle_and_audit(c: ReconfigurableCluster, names, step,
                 break
             step()
         if not converged:
-            raise SoakDivergence(
+            raise _divergence(
+                c,
                 "RSM divergence (app state or frontier never converged)",
                 {"name": nm, "members": _name_diag(c, nm, rec.actives)},
             )
@@ -276,7 +316,8 @@ def settle_and_audit(c: ReconfigurableCluster, names, step,
             for e in diag.values() if "exec_slot" in e
         }
         if len(trips) != 1:
-            raise SoakDivergence(
+            raise _divergence(
+                c,
                 "exactly-once breach: unequal (exec_slot, n_execd, "
                 "app_hash) at converged app state",
                 {"name": nm, "members": diag},

@@ -373,6 +373,43 @@ def main() -> int:
         mode = "unreplicated (app+wire only)" if args.unreplicated \
             else ("durable full system path" if args.durable
                   else "full system path")
+        # measured per-phase breakdown (the obs-plane SLO surface): the
+        # server-side phase histograms from the stats admin op + this
+        # client's end-to-end latency histogram, so a capacity artifact
+        # says WHERE the budget went, not just how much survived
+        def _hist_summary(h):
+            return {
+                "count": h["count"],
+                "avg_ms": round(h["sum"] / h["count"] * 1e3, 3),
+                "max_ms": round((h["max"] or 0.0) * 1e3, 3),
+            }
+
+        phases = {}
+        try:
+            from gigapaxos_tpu.clients import PaxosClientAsync
+
+            stats_cli = PaxosClientAsync(
+                [tuple(a) for a in client.actives.values()]
+            )
+            try:
+                st = stats_cli.admin_sync(0, {"op": "stats"}, timeout=5)
+            finally:
+                stats_cli.close()
+            hists = ((st or {}).get("engine") or {}).get("hists") or {}
+            for k in ("engine_step_s", "phase_ingress_s",
+                      "phase_execute_s", "phase_flush_s",
+                      "phase_publish_s", "pipeline_overlap_s"):
+                h = hists.get(k)
+                if h and h.get("count"):
+                    phases[k] = _hist_summary(h)
+        except Exception as e:  # a stats hiccup must not void the run
+            phases["stats_error"] = str(e)
+        cl = client.metrics.snapshot()["hists"].get(
+            "client_request_latency_s"
+        )
+        if cl and cl.get("count"):
+            phases["client_request_latency_s"] = _hist_summary(cl)
+        print(json.dumps({"phases": phases}), flush=True)
         summary = {
             "metric": "system_capacity_requests_per_s",
             "value": round(median, 1),
@@ -420,6 +457,7 @@ def main() -> int:
                 "repeats": [r["capacity_rps"] for r in repeats],
                 "curves": [r["rounds"] for r in repeats],
                 "protocol": summary["protocol"],
+                "phases": phases,
             }
             with open(args.capacity_out, "w") as f:
                 json.dump(doc, f, indent=1, sort_keys=True)

@@ -1,10 +1,19 @@
 """Varied-seed chaos sweep: run the soak over many seeds in ONE process
-(so jax compiles once), reporting every failing seed with diagnostics.
+(so jax compiles once), reporting every failing seed with diagnostics
+AND writing a machine-readable sweep artifact so strict-sweep progress
+(ROADMAP item 1) is diffable across PRs instead of log-scraped.
 
 Usage:  python scripts/chaos_sweep.py --base 1 --count 100 [--stride 7919]
+            [--out CHAOS_SWEEP_r01.json]
+
+The artifact records every seed run, every breach (exception text +
+divergence diagnostics summary), and the per-breach flight-recorder dump
+paths (``obs/flight.py`` — attached to each ``SoakDivergence`` by the
+soak) so a breach is post-mortemable from the artifact alone.
 """
 
 import argparse
+import json
 import os
 import sys
 import time
@@ -20,7 +29,7 @@ jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, ".")
 
-from gigapaxos_tpu.testing.chaos import run_soak  # noqa: E402
+from gigapaxos_tpu.testing.chaos import SoakDivergence, run_soak  # noqa: E402
 
 
 def main() -> None:
@@ -34,27 +43,70 @@ def main() -> None:
     ap.add_argument("--names", type=int, default=6)
     ap.add_argument("--loss", type=float, default=0.2)
     ap.add_argument("--dup-rate", type=float, default=0.0)
+    ap.add_argument("--out", default="CHAOS_SWEEP_r01.json",
+                    help="sweep artifact path ('' disables the write)")
     args = ap.parse_args()
 
     fails = []
+    results = []
     t0 = time.time()
     done = 0
     for i in range(args.count):
         seed = args.base + i * args.stride
         t = time.time()
         try:
-            run_soak(seed, rounds=args.rounds, n_names=args.names,
-                     loss=args.loss, dup_rate=args.dup_rate)
+            stats = run_soak(seed, rounds=args.rounds, n_names=args.names,
+                             loss=args.loss, dup_rate=args.dup_rate)
+            results.append({
+                "seed": seed, "ok": True,
+                "elapsed_s": round(time.time() - t, 1),
+                "settle_iters": stats.get("settle_iters"),
+            })
             print(f"[{i}] seed={seed} OK {time.time() - t:.1f}s", flush=True)
         except Exception as e:
             print(f"[{i}] seed={seed} FAIL {time.time() - t:.1f}s: {e}",
                   flush=True)
             traceback.print_exc()
             fails.append(seed)
+            ent = {
+                "seed": seed, "ok": False,
+                "elapsed_s": round(time.time() - t, 1),
+                "error_type": type(e).__name__,
+                # the first line carries the invariant that broke; the
+                # full diag is in the flight dumps + stdout log
+                "error": str(e)[:2000],
+            }
+            if isinstance(e, SoakDivergence):
+                ent["flight_dumps"] = e.diag.get("flight_dumps", [])
+                ent["divergent_names"] = sorted(
+                    str(v) for k, v in e.diag.items() if k == "name"
+                )
+            results.append(ent)
         done += 1
         if args.budget_s is not None and time.time() - t0 > args.budget_s:
             break
     print(f"DONE ran={done} fails={fails}", flush=True)
+    if args.out:
+        doc = {
+            "metric": "chaos_fresh_seed_sweep",
+            "strict": os.environ.get("CHAOS_FRESH_STRICT", "") == "1",
+            "params": {
+                "base": args.base, "count": args.count,
+                "stride": args.stride, "rounds": args.rounds,
+                "names": args.names, "loss": args.loss,
+                "dup_rate": args.dup_rate,
+            },
+            "ran": done,
+            "failed_seeds": fails,
+            "fail_rate": round(len(fails) / done, 4) if done else None,
+            "elapsed_s": round(time.time() - t0, 1),
+            "seeds": results,
+        }
+        tmp = args.out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+        os.replace(tmp, args.out)
+        print(f"artifact: {args.out}", flush=True)
     sys.exit(1 if fails else 0)
 
 

@@ -1,5 +1,6 @@
 #!/usr/bin/env python
-"""Observability hygiene gate: no ad-hoc stdout/stderr in the package.
+"""Observability hygiene gate: no ad-hoc stdout/stderr in the package,
+and the metric inventory (METRICS.md) may never drift from the code.
 
 AST-based static pass over ``gigapaxos_tpu/`` forbidding the two escape
 hatches the logging plane replaced:
@@ -8,21 +9,32 @@ hatches the logging plane replaced:
 * ``<anything>.stderr.write(...)`` / ``<anything>.stdout.write(...)``
   (catches ``sys.stderr.write`` and aliased imports like ``_sys``).
 
-``gigapaxos_tpu/obs/`` is exempt — it is the one place allowed to own a
-stream handler.  Run standalone (exit 1 on violations) or through the
-tier-1 test ``tests/test_obs.py::test_obs_hygiene_gate`` so future code
-stays on the logging plane.
+``gigapaxos_tpu/obs/`` is exempt from the stream rule — it is the one
+place allowed to own a stream handler.
+
+Second pass (the inventory gate): every metric name registered in code
+(``.count("…")`` / ``.gauge("…")`` / ``.observe("…")`` with a literal or
+f-string first argument) must appear in ``METRICS.md``, and every name
+documented there must exist in code.  Dynamically-labeled series
+(f-strings like ``probe_rtt_ms_active_{id}``) are documented with a
+``*`` wildcard (``probe_rtt_ms_active_*``) and matched by their literal
+prefix.  Run standalone (exit 1 on violations) or through the tier-1
+test ``tests/test_obs.py::test_obs_hygiene_gate`` so future code stays
+on the logging plane and the inventory stays true.
 """
 
 from __future__ import annotations
 
 import ast
 import pathlib
+import re
 import sys
-from typing import Iterator, Tuple
+from typing import Iterator, Set, Tuple
 
 PACKAGE = "gigapaxos_tpu"
 EXEMPT_TOP_DIRS = ("obs",)
+METRIC_METHODS = ("count", "gauge", "observe")
+METRICS_DOC = "METRICS.md"
 
 
 def _stream_write(func: ast.AST) -> bool:
@@ -55,6 +67,89 @@ def iter_violations(pkg_root: pathlib.Path) -> Iterator[Tuple[str, int, str]]:
                        "use gigapaxos_tpu.obs.gplog")
 
 
+def collect_metric_names(pkg_root: pathlib.Path) -> Tuple[Set[str], Set[str]]:
+    """Scan registration sites: returns (literal names, f-string
+    prefixes).  Only string-literal / f-string FIRST arguments to
+    ``.count/.gauge/.observe`` count — a non-string first arg (e.g. the
+    sim checker's ``observe(i, …)``) is not a metric registration."""
+    literals: Set[str] = set()
+    prefixes: Set[str] = set()
+    for path in sorted(pkg_root.rglob("*.py")):
+        tree = ast.parse(path.read_text(encoding="utf-8"),
+                         filename=str(path))
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and node.args
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in METRIC_METHODS):
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                literals.add(arg.value)
+            elif isinstance(arg, ast.JoinedStr):
+                prefix = ""
+                for part in arg.values:
+                    if isinstance(part, ast.Constant) and \
+                            isinstance(part.value, str):
+                        prefix += part.value
+                    else:
+                        break
+                if prefix:
+                    prefixes.add(prefix)
+    return literals, prefixes
+
+
+def parse_metrics_doc(doc_path: pathlib.Path) -> Tuple[Set[str], Set[str]]:
+    """Inventory rows in METRICS.md — the backticked name leading a
+    table row (``| `name` | …``): (exact names, wildcard prefixes — a
+    trailing ``*`` documents a dynamically-labeled family).  Backticked
+    words in prose are NOT inventory entries."""
+    exact: Set[str] = set()
+    wild: Set[str] = set()
+    if not doc_path.exists():
+        return exact, wild
+    for line in doc_path.read_text().splitlines():
+        m = re.match(r"^\|\s*`([a-z0-9_]+\*?)`\s*\|", line)
+        if not m:
+            continue
+        name = m.group(1)
+        if name.endswith("*"):
+            wild.add(name[:-1])
+        else:
+            exact.add(name)
+    return exact, wild
+
+
+def iter_inventory_violations(
+    pkg_root: pathlib.Path, doc_path: pathlib.Path
+) -> Iterator[str]:
+    """Two-way drift check between code registrations and METRICS.md."""
+    if not doc_path.exists():
+        yield f"{doc_path.name} missing (the metric inventory is tier-1)"
+        return
+    literals, prefixes = collect_metric_names(pkg_root)
+    exact, wild = parse_metrics_doc(doc_path)
+    for name in sorted(literals):
+        if name in exact or any(name.startswith(w) for w in wild):
+            continue
+        yield (f"metric {name!r} registered in code but absent from "
+               f"{doc_path.name}")
+    for pre in sorted(prefixes):
+        if pre in wild or pre in exact:
+            continue
+        yield (f"dynamic metric family {pre + '*'!r} registered in code "
+               f"but absent from {doc_path.name}")
+    for name in sorted(exact):
+        if name in literals or any(p.startswith(name) for p in prefixes):
+            continue
+        yield (f"{doc_path.name} documents {name!r} but no code "
+               "registers it")
+    for w in sorted(wild):
+        if w in prefixes or any(n.startswith(w) for n in literals):
+            continue
+        yield (f"{doc_path.name} documents family {w + '*'!r} but no "
+               "code registers it")
+
+
 def main(argv=None) -> int:
     root = pathlib.Path(
         (argv or sys.argv[1:] or [None])[0]
@@ -63,10 +158,13 @@ def main(argv=None) -> int:
     bad = list(iter_violations(root))
     for rel, line, why in bad:
         print(f"{PACKAGE}/{rel}:{line}: {why}")
-    if bad:
-        print(f"{len(bad)} obs-hygiene violation(s)")
+    inv = list(iter_inventory_violations(root, root.parent / METRICS_DOC))
+    for why in inv:
+        print(why)
+    if bad or inv:
+        print(f"{len(bad) + len(inv)} obs-hygiene violation(s)")
         return 1
-    print("obs hygiene clean")
+    print("obs hygiene clean (streams + metric inventory)")
     return 0
 
 

@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""gp_trace — fan ``trace_dump`` over a live cluster and merge the rings
+into causal per-request timelines (the Dapper collection/merge loop for
+this runtime).
+
+Each node's tracer ring only knows its own hops; this tool asks every
+node for its ring (the ``trace_dump`` admin op), correlates events by
+trace id / request id (``gigapaxos_tpu/obs/tracemerge.py``), and prints
+one merged timeline per request with per-hop latency attribution
+(ingress, admission, forward wire, consensus, execute, flush).
+
+Usage:
+  python scripts/gp_trace.py --servers 127.0.0.1:3000,127.0.0.1:3001 \\
+      [--rid 123 | --name probe0] [--limit 64] [--json]
+  python scripts/gp_trace.py --props scenarios/loopback_3ar_3rc.properties
+
+With ``--props`` the server list is the scenario's actives (the same
+address book ``probe.py --attach`` uses).  Requires the cluster to have
+traced something: run clients with ``GP_TRACE_SAMPLE=1`` (or any rate),
+or servers with ``GP_TRACE=1``.
+"""
+
+import argparse
+import json
+import sys
+
+sys.path.insert(0, ".")
+
+from gigapaxos_tpu.obs import tracemerge  # noqa: E402
+
+
+def fetch_dumps(client, n_servers, body, timeout=10.0):
+    """One trace_dump round trip per server (the per-member stats
+    fan-out loop from serving/router.py:_aggregate_stats — SEQUENTIAL
+    on purpose: the client's admin waiters key by (op, name), so
+    concurrent identical ops would steal each other's replies):
+    {node_id: events} for the nodes that answered."""
+    dumps = {}
+    for i in range(n_servers):
+        r = client.admin_sync(i, dict(body), timeout=timeout)
+        if r and r.get("ok"):
+            dumps[r.get("node", i)] = r.get("events") or {}
+    return dumps
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--servers", default=None,
+                    help="comma-separated host:port list (one per node)")
+    ap.add_argument("--props", default=None,
+                    help="properties file: use its active.* entries")
+    ap.add_argument("--rid", type=int, default=None,
+                    help="merge only this request id's timeline")
+    ap.add_argument("--name", default=None,
+                    help="merge the recently traced requests of this "
+                         "service name")
+    ap.add_argument("--limit", type=int, default=64,
+                    help="newest keys per node without --rid/--name")
+    ap.add_argument("--json", action="store_true",
+                    help="emit merged traces as JSON instead of text")
+    ap.add_argument("--timeout", type=float, default=10.0)
+    args = ap.parse_args()
+
+    from gigapaxos_tpu.clients import PaxosClientAsync
+    from gigapaxos_tpu.utils.config import Config
+
+    if args.props:
+        Config.load_file(args.props)
+        book = Config.node_addresses("active")
+        servers = [book[n] for n in sorted(book)]
+    elif args.servers:
+        servers = []
+        for part in args.servers.split(","):
+            host, _, port = part.strip().rpartition(":")
+            servers.append((host, int(port)))
+    else:
+        ap.error("need --servers or --props")
+        return 2
+
+    body = {"op": "trace_dump", "limit": args.limit}
+    if args.rid is not None:
+        body["rid"] = args.rid
+    if args.name is not None:
+        body["name"] = args.name
+
+    client = PaxosClientAsync(servers)
+    try:
+        dumps = fetch_dumps(client, len(servers), body, args.timeout)
+    finally:
+        client.close()
+    if not dumps:
+        print("no node answered trace_dump (cluster down, or no "
+              "tracing: set GP_TRACE_SAMPLE / GP_TRACE)", file=sys.stderr)
+        return 1
+    traces = tracemerge.merge_node_dumps(dumps)
+    if args.json:
+        print(json.dumps({
+            "nodes": sorted(dumps),
+            "traces": traces,
+        }, indent=1))
+    else:
+        if not traces:
+            print("nodes answered but no matching trace events "
+                  f"(nodes: {sorted(dumps)})")
+            return 1
+        for tr in traces:
+            print(tracemerge.render_trace(tr))
+            print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

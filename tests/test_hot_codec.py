@@ -16,6 +16,9 @@ REQ_ITEMS = [
     (123456789012345678, "probe0", "value-äß\x00end", False),
     ((1 << 61) + 7, "n", "", True),
     (1, "a" * 300, "v" * 5000, False),
+    # traced item: the 5-tuple form carries (tid, origin, hop)
+    (99, "tr", "tv", False, ((1 << 62) + 5, 3, 2)),
+    (100, "tr2", "", True, (1, -1, 0)),  # client origin tag -1
 ]
 RESP_ITEMS = [
     {"request_id": 42, "response": "ok:1", "name": "probe0"},
@@ -23,11 +26,18 @@ RESP_ITEMS = [
     {"request_id": 44, "response": None, "name": "y",
      "error": "unknown_name"},
     {"request_id": 45, "response": "", "name": "z", "error": "exhausted"},
+    # traced response: the "tc" field rides a fixed 13-byte tail
+    {"request_id": 46, "response": "ok", "name": "t",
+     "tc": [(1 << 62) + 5, 3, 2]},
+    {"request_id": 47, "response": None, "name": "t2",
+     "error": "overload", "tc": [7, -1, 0]},
 ]
 
 # golden bytes pin the WIRE layout (computed from the documented layout,
 # not from the codec — a layout change must fail here, not silently
-# re-golden): one item, rid=7, stop, name "ab", value "c"
+# re-golden): one item, rid=7, stop, name "ab", value "c".  UNTRACED
+# frames must stay byte-identical to the pre-trace wire format — these
+# two goldens are unchanged from before the trace field existed.
 GOLDEN_R = (
     b"R" + struct.pack("<iI", -1, 1)
     + struct.pack("<QBHI", 7, 1, 2, 1) + b"ab" + b"c"
@@ -36,6 +46,18 @@ GOLDEN_R = (
 GOLDEN_S = (
     b"S" + struct.pack("<iI", 2, 1)
     + struct.pack("<QBBHI", 9, 1, 0, 1, 0) + b"n"
+)
+# traced goldens: flag bit1 set, 13-byte trace tail (tid u64, origin
+# i32, hop u8) appended after the payload bytes
+GOLDEN_R_TRACED = (
+    b"R" + struct.pack("<iI", -1, 1)
+    + struct.pack("<QBHI", 7, 1 | 2, 2, 1) + b"ab" + b"c"
+    + struct.pack("<QiB", 0x1122334455667788, 3, 2)
+)
+GOLDEN_S_TRACED = (
+    b"S" + struct.pack("<iI", 2, 1)
+    + struct.pack("<QBBHI", 9, 1, 0 | 2, 1, 0) + b"n"
+    + struct.pack("<QiB", 0x1122334455667788, 3, 2)
 )
 
 
@@ -72,6 +94,28 @@ def test_golden_bytes(codec_mode):
         "request_id": 9, "response": None, "name": "n",
         "error": "overload",
     }]) == GOLDEN_S
+
+
+def test_golden_bytes_traced(codec_mode):
+    """The trace field pinned on the wire — present AND absent: the
+    traced item appends exactly the 13-byte (tid, origin, hop) tail
+    behind the flag bit, and the untraced goldens above prove absence
+    is byte-identical to the pre-trace format."""
+    tc = (0x1122334455667788, 3, 2)
+    frame = hot_codec.encode_request_batch(-1, [(7, "ab", "c", True, tc)])
+    assert frame == GOLDEN_R_TRACED
+    assert hot_codec.decode_request_batch(frame) == (
+        -1, [(7, "ab", "c", True, tc)]
+    )
+    sframe = hot_codec.encode_response_batch(2, [{
+        "request_id": 9, "response": None, "name": "n",
+        "error": "overload", "tc": list(tc),
+    }])
+    assert sframe == GOLDEN_S_TRACED
+    _s, items = hot_codec.decode_response_batch(sframe)
+    assert items[0]["tc"] == list(tc)
+    assert items[0]["error"] == "overload"
+    assert items[0]["response"] is None
 
 
 def test_native_python_parity():
