@@ -57,12 +57,13 @@ from .ops.engine import (
     EngineConfig,
     EngineState,
     init_state,
+    StepOutputs,
     make_blob,
     pack_blob,
+    split_blob_vec,
     split_out_vec,
-    step,
-    step_host,
 )
+from .parallel.spmd import make_step
 from .obs import gplog
 from .obs.flight import FlightRecorder
 from .obs.metrics import MetricsRegistry
@@ -71,17 +72,22 @@ from .ops.lifecycle import create_groups, kill_groups
 from .storage.logger import PaxosLogger
 from .utils.profiler import DelayProfiler
 
-_step_jit = jax.jit(step, static_argnames=("cfg",))
-# donate the state: the manager owns it exclusively (every external view
+# Every tick flavor steps through the ONE unified factory
+# (parallel/spmd.py:make_step, io="packed_host").  The dispatch path
+# donates the state: the manager owns it exclusively (every external view
 # is an identity check or a host-side numpy copy), so the old buffers may
 # be reused in place by the new state — on-device this halves state HBM;
-# backends without donation support ignore it.  The per-leaf _step_jit
-# path is NOT donated: its returned blob leaves alias the live state (the
-# test-cluster harness caches those blobs across ticks).
-_step_host_jit = jax.jit(
-    step_host, static_argnames=("cfg",), donate_argnums=(0,)
-)
+# backends without donation support ignore it.  The Blob-exchange tick
+# (_tick_locked, the test-cluster harness) uses a donate=False instance:
+# that harness caches blob views aliasing the live state across ticks.
 _pack_blob_jit = jax.jit(pack_blob)
+# Blob of [R, ...] leaves -> [R, NB] packed rows (Blob._fields order, C
+# ravel per leaf — each row identical to pack_blob of that replica's
+# blob); the Blob-exchange tick packs its gathered blobs through this to
+# reach the unified packed step.
+_pack_rows_jit = jax.jit(
+    lambda b: jnp.concatenate([x.reshape(x.shape[0], -1) for x in b], axis=1)
+)
 
 
 def _mix32(h: int, vid: int) -> int:
@@ -323,6 +329,26 @@ class PaxosManager:
         # minimum queued requests before coalescing bothers minting a batch
         # (MIN_PP_BATCH_SIZE gate analog, PaxosConfig.java:852)
         self.min_batch_trigger = max(2, Config.get_int(PC.MIN_PP_BATCH_SIZE))
+        # multi-step device residency: N consensus rounds per host
+        # dispatch over device-resident request/response rings — one
+        # Python dispatch + sync + post-step cycle per N engine steps
+        self.steps_per_dispatch = max(
+            1, Config.get_int(PC.ENGINE_STEPS_PER_DISPATCH)
+        )
+        # the ONE unified step (parallel/spmd.py:make_step), packed-host
+        # flavor; instances are memoized by (cfg, N, donate), so jit
+        # caches are shared across managers with the same shape
+        self._dispatch_step = make_step(
+            cfg, None, self.steps_per_dispatch, donate=True,
+            io="packed_host",
+        )
+        self._tick_step = make_step(
+            cfg, None, self.steps_per_dispatch, donate=False,
+            io="packed_host",
+        )
+        # vids staged into the device request ring by the LAST dispatch
+        # (the device_queue_depth gauge)
+        self._last_ring_depth = 0
         # test/emulation modes (PaxosManager.java:1731-1778): UNREPLICATED
         # answers at the entry replica without consensus (isolates app+wire
         # cost); LAZY_PROPAGATION additionally still drives consensus but
@@ -501,10 +527,10 @@ class PaxosManager:
 
         The returned array is a PRIVATE copy when np.asarray would be a
         zero-copy view of the device buffer (`.base` set — the CPU
-        backend): _step_host_jit donates the state, so a view held by a
-        transport thread past its lock region would read buffers a later
-        tick overwrites in place.  Device backends already transfer into
-        a fresh host buffer (`.base` None)."""
+        backend): the dispatch step donates the state, so a view held by
+        a transport thread past its lock region would read buffers a
+        later tick overwrites in place.  Device backends already transfer
+        into a fresh host buffer (`.base` None)."""
         with self._state_lock:
             if self._np_cache_state is not self.state:
                 self._np_cache = {}
@@ -2224,10 +2250,19 @@ class PaxosManager:
         return out
 
     def build_requests(self) -> np.ndarray:
-        """Drain queues into [G, K] lanes; forward non-coordinated groups'
-        requests to their believed coordinator."""
+        """Single-step [G, K] lanes (the n_steps=1 face of the ring)."""
+        return self.build_request_ring(1)[0]
+
+    def build_request_ring(self, n_steps: int) -> np.ndarray:
+        """Drain queues into the [n_steps, G, K] device request ring —
+        slab i feeds dispatch substep i, so one host admission pass
+        covers N engine steps; forward non-coordinated groups' requests
+        to their believed coordinator.  Records the staged vid count for
+        the ``device_queue_depth`` gauge."""
         G, K = self.cfg.n_groups, self.cfg.req_lanes
-        req = np.full((G, K), NULL, np.int32)
+        depth = K * n_steps
+        req = np.full((n_steps, G, K), NULL, np.int32)
+        staged = 0
         bal = self._np("bal")
         for row, vids in list(self.queues.items()):
             if not vids:
@@ -2311,15 +2346,19 @@ class PaxosManager:
                 vids.clear()
                 continue
             if self.batching_enabled and len(vids) > max(
-                K, self.min_batch_trigger - 1
+                depth, self.min_batch_trigger - 1
             ):
                 name = self.row_name.get(row)
                 if name is not None:
                     vids = self.queues[row] = self._coalesce_row_queue(
                         row, name, int(self._np("version")[row]), vids
                     )
-            take = vids[:K]
-            req[row, : len(take)] = take
+            take = vids[:depth]
+            for off in range(0, len(take), K):
+                slab = take[off:off + K]
+                req[off // K, row, : len(slab)] = slab
+            staged += len(take)
+        self._last_ring_depth = staged
         return req
 
     def tick(
@@ -2404,7 +2443,7 @@ class PaxosManager:
             self._await_step_locked()  # single-depth pipeline
             cfg = self.cfg
             G = cfg.n_groups
-            req = self.build_requests()
+            req = self.build_request_ring(self.steps_per_dispatch)
             wc = (
                 np.zeros((G,), bool) if want_coord is None
                 else np.asarray(want_coord, bool)
@@ -2429,10 +2468,9 @@ class PaxosManager:
                     arr = np.asarray(getattr(old_state, leaf))
                     carry[leaf] = arr.copy() if arr.base is not None else arr
             t0 = time.monotonic()
-            new_state, out_vec, blob_vec = _step_host_jit(
+            new_state, out_vec, blob_vec = self._dispatch_step(
                 old_state, jnp.asarray(gathered_vec), jnp.asarray(heard),
                 jnp.asarray(req), jnp.asarray(wc), jnp.int32(self.my_id),
-                cfg=cfg,
             )
             self.state = new_state
             self._np_cache = carry
@@ -2463,8 +2501,8 @@ class PaxosManager:
                 DelayProfiler.update_count(
                     "t_engine_step", self.last_engine_step_s
                 )
-                out_np = split_out_vec(out_np_vec, self.cfg)
-                host_delta = self._post_step_locked(out_np)
+                outs = [split_out_vec(row, self.cfg) for row in out_np_vec]
+                host_delta = self._post_step_locked(outs)
             finally:
                 self._step_inflight = False
                 self._step_thread = None
@@ -2483,24 +2521,23 @@ class PaxosManager:
         self._await_step_locked()
         cfg = self.cfg
         G = cfg.n_groups
-        req = self.build_requests()
+        req = self.build_request_ring(self.steps_per_dispatch)
         wc = (
             np.zeros((G,), bool) if want_coord is None
             else np.asarray(want_coord, bool)
         )
         t0 = time.monotonic()
-        new_state, out_vec, blob_vec = _step_host_jit(
+        new_state, out_vec, blob_vec = self._dispatch_step(
             self.state, jnp.asarray(gathered_vec), jnp.asarray(heard),
             jnp.asarray(req), jnp.asarray(wc), jnp.int32(self.my_id),
-            cfg=cfg,
         )
         self.state = new_state
         out_np_vec = np.asarray(out_vec)  # one transfer; forces the sync
         DelayProfiler.update_delay("engine_step", t0)
         self.last_engine_step_s = time.monotonic() - t0
         DelayProfiler.update_count("t_engine_step", self.last_engine_step_s)
-        out_np = split_out_vec(out_np_vec, cfg)
-        host_delta = self._post_step_locked(out_np)
+        outs = [split_out_vec(row, cfg) for row in out_np_vec]
+        host_delta = self._post_step_locked(outs)
         return np.asarray(blob_vec), new_state, host_delta
 
     def _tick_locked(
@@ -2511,66 +2548,87 @@ class PaxosManager:
     ) -> Tuple[Blob, Dict]:
         self._await_step_locked()
         cfg = self.cfg
-        G, W, K = cfg.n_groups, cfg.window, cfg.req_lanes
-        req = self.build_requests()
+        G = cfg.n_groups
+        req = self.build_request_ring(self.steps_per_dispatch)
         wc = (
-            jnp.zeros((G,), bool) if want_coord is None
-            else jnp.asarray(want_coord, bool)
+            np.zeros((G,), bool) if want_coord is None
+            else np.asarray(want_coord, bool)
         )
+        # the Blob-of-leaves exchange reaches the unified packed step as
+        # one [R, NB] matrix (each row == pack_blob of that replica);
+        # donate=False — the test-cluster harness caches blob views that
+        # alias the live state across ticks
+        gvec = _pack_rows_jit(gathered)
         t0 = time.monotonic()
-        new_state, out = _step_jit(
-            self.state, gathered, jnp.asarray(heard),
-            jnp.asarray(req), wc, jnp.int32(self.my_id), cfg=cfg,
+        new_state, out_vec, blob_vec = self._tick_step(
+            self.state, gvec, jnp.asarray(heard),
+            jnp.asarray(req), jnp.asarray(wc), jnp.int32(self.my_id),
         )
         self.state = new_state
-        # sync here so the engine's compute is attributed to the engine —
-        # jax dispatch is async and the implicit sync would otherwise land
-        # in the first np.asarray below, polluting host-cost accounting
-        # (the conversion right after forces the sync anyway, so this adds
-        # no real wall time to the tick)
-        jax.block_until_ready(out)
+        out_np_vec = np.asarray(out_vec)  # one transfer; forces the sync
         # update_delay takes the START time (it computes monotonic()-t0)
         DelayProfiler.update_delay("engine_step", t0)
         self.last_engine_step_s = time.monotonic() - t0
         DelayProfiler.update_count("t_engine_step", self.last_engine_step_s)
 
-        out_np = jax.tree.map(np.asarray, out)
-        host_delta = self._post_step_locked(out_np)
-        return make_blob(self.state), host_delta
+        outs = [split_out_vec(row, cfg) for row in out_np_vec]
+        host_delta = self._post_step_locked(outs)
+        return split_blob_vec(np.asarray(blob_vec), cfg), host_delta
 
-    def _post_step_locked(self, out_np) -> Dict:
+    def _post_step_locked(self, outs) -> Dict:
         """Shared post-engine host work (requeue, watermarks, journaling,
-        execution, state pulls, gossip delta) for both tick flavors."""
+        execution, state pulls, gossip delta) for every tick flavor.
+
+        ``outs`` is the dispatch's LIST of per-substep StepOutputs (a
+        bare StepOutputs is accepted as a 1-list) — one host cycle per
+        dispatch covers all N device-resident substeps: per-substep work
+        (decision logging, execution, preempt requeue) runs in substep
+        order; per-dispatch work (ballot pull, watermarks, checkpoint
+        cadence, gossip delta) runs once against the final state."""
+        if isinstance(outs, StepOutputs):
+            outs = [outs]
+        last = outs[-1]
+        n_sub = len(outs)
         self._tick_no += 1
-        if (
-            out_np.n_admitted.any() or out_np.n_committed.any()
-            or out_np.acc_new.any() or out_np.bal_new.any()
+        if any(
+            o.n_admitted.any() or o.n_committed.any()
+            or o.acc_new.any() or o.bal_new.any()
+            for o in outs
         ):
             self.last_progress_tick = self._tick_no
-        # re-propose preempted requests at a fresh slot (PREEMPTED analog)
-        pre_g, pre_l = np.nonzero(out_np.preempted_vid != NULL)
-        for g_, l_ in zip(pre_g, pre_l):
-            vid = int(out_np.preempted_vid[g_, l_])
-            if vid in self.arena and vid not in self.retained:
-                self.queues.setdefault(int(g_), []).append(vid)
+        # re-propose preempted requests at a fresh slot (PREEMPTED
+        # analog), in substep order; appended AFTER the ring requeue
+        # below so a vid preempted at substep i cannot collide with the
+        # slab bookkeeping of substeps > i
+        preempt_requeue = []
+        for o in outs:
+            pre_g, pre_l = np.nonzero(o.preempted_vid != NULL)
+            for g_, l_ in zip(pre_g, pre_l):
+                vid = int(o.preempted_vid[g_, l_])
+                if vid in self.arena and vid not in self.retained:
+                    preempt_requeue.append((int(g_), vid))
         # per-step engine metrics: aggregate counters reduced from the
-        # vectorized step outputs — a few O(G) numpy sums per TICK (the
-        # engine step itself is ~1ms), never per-request host work
+        # vectorized step outputs — a few O(G) numpy sums per DISPATCH
+        # (the engine step itself is ~1ms), never per-request host work
         mx = self.metrics
-        n_dec = int(out_np.n_committed.sum())
+        n_dec = int(sum(int(o.n_committed.sum()) for o in outs))
         if n_dec:
             mx.count("decisions_executed", n_dec)
-        n_admit = int(out_np.n_admitted.sum())
+        n_admit = int(sum(int(o.n_admitted.sum()) for o in outs))
         if n_admit:
             mx.count("requests_admitted", n_admit)
-        if len(pre_g):
-            mx.count("preempts", len(pre_g))
+        if preempt_requeue:
+            mx.count("preempts", len(preempt_requeue))
+        bal_rose = outs[0].bal_new
+        for o in outs[1:]:
+            bal_rose = bal_rose | o.bal_new
         flips = rises = 0
-        if out_np.bal_new.any():
+        if bal_rose.any():
             # coordinator flips: `bal` is only pulled host-side on the
-            # rare ticks where a promised ballot rose (elections), and
-            # only the risen rows are compared against the cached view
-            pg_m = np.nonzero(out_np.bal_new)[0]
+            # rare dispatches where a promised ballot rose (elections),
+            # and only the risen rows are compared against the cached
+            # view; the pull reflects the dispatch-final state
+            pg_m = np.nonzero(bal_rose)[0]
             bal_host = self._np("bal")
             self._bal_host = bal_host.copy()
             new_coord = ballot_coord(bal_host[pg_m]).astype(np.int32)
@@ -2584,11 +2642,19 @@ class PaxosManager:
         mx.gauge("inflight_requests", len(self.inflight))
         mx.gauge("arena_payloads", len(self.arena))
         mx.observe("engine_step_s", self.last_engine_step_s)
+        # residency plane: steps amortized per host dispatch, staged
+        # device-ring depth, and the per-substep amortized host cost
+        mx.count("host_dispatches")
+        mx.gauge("dispatch_steps_per_host", n_sub)
+        mx.gauge("device_queue_depth", self._last_ring_depth)
+        mx.observe(
+            "dispatch_amortized_s", self.last_engine_step_s / n_sub
+        )
         # flight recorder: the per-step summary ring (always on; skips
         # pure-idle ticks internally so the ring spans real history)
         self.flight.record_step(
             tick=self._tick_no, admitted=n_admit, decided=n_dec,
-            preempts=len(pre_g), coordinator_flips=flips,
+            preempts=len(preempt_requeue), coordinator_flips=flips,
             ballot_rises=rises,
             frontier_stalls=len(self._payload_blocked),
             inflight=len(self.inflight),
@@ -2613,26 +2679,37 @@ class PaxosManager:
         # catch up through the rings and will recover via checkpoint
         # transfer instead (state_request/state_reply below) — without
         # this, one dead member pins every payload forever.
-        horizon = out_np.maj_exec.astype(np.int64) - self.jump_horizon
+        horizon = last.maj_exec.astype(np.int64) - self.jump_horizon
         eligible = in_group & (cursors >= horizon[None, :])
         cur_masked = np.where(eligible, cursors, np.iinfo(np.int64).max)
         self._min_exec = np.where(
             eligible.any(axis=0), cur_masked.min(axis=0), self._min_exec
         )
-        # requeue what wasn't admitted
-        n_adm = out_np.n_admitted
+        # requeue what wasn't admitted: the ring staged queue slab i into
+        # substep i's lanes, and the engine admits a contiguous prefix
+        # per slab — admitted = union of slab prefixes, leftovers keep
+        # their order ahead of the unstaged tail
+        K = self.cfg.req_lanes
         payload_delta: Dict[int, str] = {}
         meta_delta: Dict[int, Tuple[int, int]] = {}
         for row, vids in list(self.queues.items()):
             if not vids:
                 continue
-            n = int(n_adm[row])
-            admitted, rest = vids[:n], vids[n:]
+            admitted: List[int] = []
+            rest: List[int] = []
+            for i, o in enumerate(outs):
+                slab = vids[i * K:(i + 1) * K]
+                na = int(o.n_admitted[row])
+                admitted += slab[:na]
+                rest += slab[na:]
+            rest += vids[n_sub * K:]
             self.queues[row] = rest
             for vid in admitted:
                 payload_delta[vid] = self.arena.get(vid, "")
                 if vid in self.vid_meta:
                     meta_delta[vid] = self.vid_meta[vid]
+        for row, vid in preempt_requeue:
+            self.queues.setdefault(row, []).append(vid)
 
         # log-before-send: persist the promise + accept delta before the
         # blob leaves (bare promises too — a ballot that rose with no
@@ -2643,11 +2720,20 @@ class PaxosManager:
         # so log-before-send still holds for the published blob.
         if self.logger is not None:
             with self.logger.batch():
-                pg = np.nonzero(out_np.bal_new)[0]
+                pg = np.nonzero(bal_rose)[0]
                 if len(pg):
                     bal_np = self._np("bal")
                     self.logger.log_promises(pg.astype(np.int32), bal_np[pg])
-                gs, lanes = np.nonzero(out_np.acc_new)
+                # accept lanes changed by ANY substep, valued from the
+                # dispatch-final state: a lane overwritten by a LATER
+                # substep's accept implies its earlier slot was decided
+                # within this dispatch, and that decision is journaled
+                # per substep by _execute below — so the final lane view
+                # plus the per-substep decision log loses nothing
+                acc_any = outs[0].acc_new
+                for o in outs[1:]:
+                    acc_any = acc_any | o.acc_new
+                gs, lanes = np.nonzero(acc_any)
                 if len(gs):
                     acc_slot = self._np("acc_slot")
                     acc_bal = self._np("acc_bal")
@@ -2660,10 +2746,12 @@ class PaxosManager:
                     )
                 if payload_delta:
                     self.logger.log_payloads(payload_delta, meta=meta_delta)
-                self._execute(out_np)
+                for o in outs:
+                    self._execute(o)
         else:
-            self._execute(out_np)
-        self._maybe_request_state(out_np)
+            for o in outs:
+                self._execute(o)
+        self._maybe_request_state(last)
         self.outstanding.gc()
         if self._tick_no % 64 == 0 and self.inflight:
             # entries whose vid left vid_meta (forwarded to a coordinator /
@@ -2671,7 +2759,7 @@ class PaxosManager:
             self.inflight = {
                 r: v for r, v in self.inflight.items() if v in self.vid_meta
             }
-        self._maybe_checkpoint(out_np)
+        self._maybe_checkpoint(last)
 
         # periodic full-baseline refresh: a dropped gossip frame must not
         # strand peers' cursor views forever (the sparse delta has no

@@ -1,55 +1,75 @@
-"""SPMD wrappers for the consensus step.
+"""The unified, mesh-parameterized consensus step.
 
-Three execution modes over the same pure :func:`gigapaxos_tpu.ops.engine.step`:
+ONE factory — :func:`make_step` — builds every execution shape of the pure
+:func:`gigapaxos_tpu.ops.engine.step`:
 
-* :func:`spmd_step` — shard_map over a ``(g, r)`` mesh: each replica chip
-  holds its own engine state shard; the blob exchange is a single
-  ``lax.all_gather`` over the replica axis (ICI).  This is the
-  acceptor-per-chip deployment shape (BASELINE.json: 3 chips as acceptors)
-  and what the driver's ``dryrun_multichip`` exercises.
+* the **mesh is data**, not a code path: ``None`` runs on one device; a
+  ``(g, r)`` mesh shards groups over 'g' and replicas over 'r' (the
+  acceptor-per-chip deployment — the cross-replica blob exchange becomes
+  an all_gather over 'r' that XLA inserts from the sharding constraints);
+  a 1-D ``('g',)`` mesh shards only groups, keeping all R replica rows
+  device-local so the step has **zero cross-device collectives** (the
+  weak-scaling headline shape).  All three are the same traced program
+  under different ``NamedSharding``/``PartitionSpec`` constraints, so the
+  engine's all-int32 arithmetic is bit-identical across partitionings.
 
-* :func:`group_sharded_step` — shard_map over a 1-D ``('g',)`` mesh
-  covering ALL devices: each device hosts G/n_shards groups × all R
-  replica rows, so the blob "exchange" is the device-local stacked blobs
-  and the step has **zero cross-device collectives** (groups are fully
-  independent).  This is the weak-scaling headline shape: aggregate
-  dec/s and hosted-group capacity both scale ~linearly with the mesh,
-  and per-device HBM is ``bytes_per_group x G / n_shards``.  A G that
-  does not divide the mesh pads with inert rows (``pad_group_states``)
-  which the step keeps frozen (member_mask 0 -> non-member -> no-op).
+* ``steps_per_dispatch`` (N >= 1) runs N consensus rounds **per host
+  call** over device-resident request/response rings: admission gating,
+  dedup lookup, and response selection all happen inside a
+  ``lax.fori_loop``, and the host touches one packed request ring
+  ``[N, ...]`` going in and one response ring coming out — one Python
+  dispatch, one sync, per N engine steps.  N == 1 compiles the exact
+  legacy single-step program (no loop machinery), so the default path is
+  bit-for-bit the pre-factory step.
 
-* :func:`single_chip_step` — all R replica states stacked on one device and
-  advanced with ``vmap``; the "gather" is just the stacked blobs.  This is
-  the loopback/bench mode on a single TPU chip (the analog of the
-  reference's N-nodes-in-one-JVM testing mode, ``PaxosManager.java:108-111``).
+Two I/O flavors:
+
+* ``io="stacked"`` — the SPMD/bench face: states are the stacked
+  ``[R, G, ...]`` global layout, requests ``[R, G, K]`` (or
+  ``[N, R, G, K]`` for N > 1), outputs :class:`StepOutputs` of
+  ``[R, ...]`` (or ``[N, R, ...]``) leaves.  Every replica advances each
+  substep and the blob exchange is re-read from the advancing states, so
+  N stacked substeps are exactly N sequential stacked calls.
+
+* ``io="packed_host"`` — the deployed-runtime face (one replica's state,
+  peers' blobs arriving as the packed ``[R, NB]`` gathered matrix == the
+  ``D`` wire-frame bodies): returns ``(state', out_rings [N, M],
+  blob_vec)``.  Substep 0 consumes the gathered rows exactly as passed;
+  substeps >= 1 refresh only MY row from the advancing state while
+  peers' rows stay frozen — the semantics of N serial host ticks during
+  which no new peer frame lands.
+
+The three pre-factory entry points (``single_chip_step``, ``spmd_step``,
+``group_sharded_step``) survive as thin deprecated aliases over the
+factory.
 
 Global array convention for SPMD: every state leaf gets a leading replica
-axis -> ``[R, G, ...]``; ``spmd_step`` shards ``P('r', 'g')``,
-``group_sharded_step`` shards ``P(None, 'g')`` (replica axis device-local).
+axis -> ``[R, G, ...]``; a ``(g, r)`` mesh constrains ``P('r', 'g')``, a
+``('g',)`` mesh ``P(None, 'g')`` (replica axis device-local).
 """
 
 from __future__ import annotations
 
+import functools
 from functools import partial
-from typing import List
+from typing import List, Optional
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:  # jax >= 0.6 exports shard_map at top level (replica-check kwarg renamed)
-    from jax import shard_map as _shard_map
-
-    _SHARD_MAP_CHECK_KW = {"check_vma": False}
-except ImportError:  # jax 0.4/0.5: experimental namespace, check_rep kwarg
-    from jax.experimental.shard_map import shard_map as _shard_map
-
-    _SHARD_MAP_CHECK_KW = {"check_rep": False}
-
-shard_map = _shard_map
-
-from ..ops.engine import EngineConfig, EngineState, StepOutputs, make_blob, step
+from ..ops.engine import (
+    _G_LEAVES,
+    EngineConfig,
+    EngineState,
+    StepOutputs,
+    make_blob,
+    out_vec_len,
+    pack_blob,
+    step,
+    unpack_gathered,
+)
 from .mesh import GROUP_AXIS, REPLICA_AXIS
 
 
@@ -78,108 +98,278 @@ def build_replica_states(cfg: EngineConfig, coord0=None) -> EngineState:
     ])
 
 
-def single_chip_step(cfg: EngineConfig, donate: bool = True):
-    """vmap-over-replicas step on one device.
+# ---------------------------------------------------------------------------
+# mesh-as-data: sharding constraints instead of per-mesh code paths
+# ---------------------------------------------------------------------------
 
-    Takes (states [R,...], req_vid [R,G,K], want_coord [R,G]) and returns
-    (states', outputs [R,...]).  ``heard`` is an optional [R(recv), R(send)]
-    bool delivery matrix for fault injection (the reference drops a crashed
-    node's traffic in TESTPaxosConfig.crash/isCrashed,
-    ``testing/TESTPaxosConfig.java:563-580``); row i masks which peers'
-    blobs replica i consumes this step.  None (the default) means full
-    delivery.  A replica always hears itself — the diagonal is forced.
 
-    ``donate=True`` (default) aliases the caller's old stacked states into
-    the outputs — halves state HBM (the G=2M capacity lever; a no-op on
-    backends that ignore donation) but requires the caller to thread
-    states through every call.  Pass ``donate=False`` for a step whose
-    input states stay valid across calls (e.g. reusable example args).
-    """
+def _mesh_spec(mesh: Mesh, *lead) -> P:
+    """PartitionSpec over the leading axes, keeping only names the mesh
+    actually has — a ``(g, r)`` mesh yields ``P('r', 'g')`` where a
+    ``('g',)`` mesh yields ``P(None, 'g')`` from the same request."""
+    return P(*[
+        a if (a is not None and a in mesh.axis_names) else None for a in lead
+    ])
+
+
+def _constrain(mesh: Optional[Mesh], tree, *lead):
+    """Pin every leaf's leading dims to the mesh (no-op off-mesh).  This
+    is the whole mesh parameterization: the traced program is identical;
+    only the GSPMD partitioning (and hence the auto-inserted collectives,
+    e.g. the 'r' all_gather of the compact blob exchange) changes."""
+    if mesh is None:
+        return tree
+    sh = NamedSharding(mesh, _mesh_spec(mesh, *lead))
+    return jax.tree.map(
+        lambda x: lax.with_sharding_constraint(x, sh), tree
+    )
+
+
+# ---------------------------------------------------------------------------
+# the factory
+# ---------------------------------------------------------------------------
+
+
+def _build_stacked(cfg: EngineConfig, mesh: Optional[Mesh], n_steps: int,
+                   donate: bool):
     R = cfg.n_replicas
-    my_ids = jnp.arange(R, dtype=jnp.int32)
 
-    def _one(state, gathered, heard_row, req, want, my_id):
-        return step(state, gathered, heard_row, req, want, my_id, cfg)
-
-    @partial(jax.jit, donate_argnums=(0,) if donate else ())
-    def run(states, req_vid, want_coord, heard=None):
-        h = jnp.ones((R, R), bool) if heard is None else (
-            jnp.asarray(heard, bool) | jnp.eye(R, dtype=bool)
-        )
+    def _exchange_step(states, req_vid, want_coord, h):
+        # run under the ARRAY group count: padded [R, Gp, ...] states
+        # (group-sharded deployments, pad_group_states) step with the
+        # engine's internal index planes sized Gp; inert pad rows stay
+        # frozen (member_mask 0 -> non-member -> no-op)
+        run_cfg = cfg._replace(n_groups=int(states.bal.shape[1]))
+        # the exchange payload is the COMPACT blob (4 [G] + 4 [G, W]
+        # int32 leaves vs the state's 12 + 7): on a replica-sharded mesh
+        # the in_axes=None consumption below is what XLA turns into the
+        # all_gather over 'r' — ~42% fewer ICI bytes than pre-compact
         blobs = jax.vmap(make_blob)(states)
+        my_ids = jnp.arange(R, dtype=jnp.int32)
+
+        def _one(state, gathered, heard_row, req, want, my_id):
+            return step(state, gathered, heard_row, req, want, my_id,
+                        run_cfg)
+
         return jax.vmap(_one, in_axes=(0, None, 0, 0, 0, 0))(
             states, blobs, h, req_vid, want_coord, my_ids
         )
 
-    return run
+    def _heard(heard):
+        # a replica always hears itself — the diagonal is forced (ref
+        # fault model: testing/TESTPaxosConfig.java:563-580)
+        return jnp.ones((R, R), bool) if heard is None else (
+            jnp.asarray(heard, bool) | jnp.eye(R, dtype=bool)
+        )
+
+    if n_steps == 1:
+        @partial(jax.jit, donate_argnums=(0,) if donate else ())
+        def run(states, req_vid, want_coord, heard=None):
+            h = _heard(heard)
+            states = _constrain(mesh, states, REPLICA_AXIS, GROUP_AXIS)
+            new_states, outs = _exchange_step(
+                states, req_vid, want_coord, h
+            )
+            return (
+                _constrain(mesh, new_states, REPLICA_AXIS, GROUP_AXIS),
+                _constrain(mesh, outs, REPLICA_AXIS, GROUP_AXIS),
+            )
+
+        return run
+
+    @partial(jax.jit, donate_argnums=(0,) if donate else ())
+    def run_n(states, req_ring, want_coord, heard=None):
+        # req_ring [N, R, G, K]: slab i feeds substep i.  want_coord
+        # fires only at substep 0 (an election pulse is a host decision;
+        # replaying it every substep would re-bump ballots N times).
+        # heard is frozen for the dispatch — the host's delivery view
+        # cannot change mid-dispatch by construction.
+        h = _heard(heard)
+        states = _constrain(mesh, states, REPLICA_AXIS, GROUP_AXIS)
+        G = int(states.bal.shape[1])
+        W = cfg.window
+        outs0 = StepOutputs(*[
+            jnp.zeros(
+                (n_steps, R) + ((G,) if f in _G_LEAVES else (G, W)),
+                jnp.int32,
+            )
+            for f in StepOutputs._fields
+        ])
+
+        def body(i, carry):
+            st, outs = carry
+            req_i = lax.dynamic_index_in_dim(
+                req_ring, i, axis=0, keepdims=False
+            )
+            want_i = want_coord & (i == 0)
+            st, out = _exchange_step(st, req_i, want_i, h)
+            outs = jax.tree.map(
+                lambda acc, o: lax.dynamic_update_index_in_dim(
+                    acc, o, i, axis=0
+                ),
+                outs, out,
+            )
+            return st, outs
+
+        new_states, outs = lax.fori_loop(0, n_steps, body, (states, outs0))
+        return (
+            _constrain(mesh, new_states, REPLICA_AXIS, GROUP_AXIS),
+            _constrain(mesh, outs, None, REPLICA_AXIS, GROUP_AXIS),
+        )
+
+    return run_n
+
+
+def _build_packed(cfg: EngineConfig, mesh: Optional[Mesh], n_steps: int,
+                  donate: bool):
+    R = cfg.n_replicas
+    M = out_vec_len(cfg)
+
+    def _pack_out(out):
+        return jnp.concatenate([jnp.ravel(leaf) for leaf in out])
+
+    if n_steps == 1:
+        # the exact legacy step_host program (plus a trivial [1, M]
+        # reshape): one upload, one step, two downloads
+        @partial(jax.jit, donate_argnums=(0,) if donate else ())
+        def run(state, gvec, heard, req_ring, want_coord, my_id):
+            state = _constrain(mesh, state, GROUP_AXIS)
+            g = unpack_gathered(gvec, cfg)
+            new_state, out = step(
+                state, g, heard, req_ring[0], want_coord, my_id, cfg=cfg
+            )
+            out_rings = _pack_out(out)[None]
+            blob_vec = pack_blob(make_blob(new_state))
+            return (
+                _constrain(mesh, new_state, GROUP_AXIS),
+                out_rings, blob_vec,
+            )
+
+        return run
+
+    @partial(jax.jit, donate_argnums=(0,) if donate else ())
+    def run_n(state, gvec, heard, req_ring, want_coord, my_id):
+        state = _constrain(mesh, state, GROUP_AXIS)
+        gathered0 = unpack_gathered(gvec, cfg)
+        out0 = jnp.zeros((n_steps, M), jnp.int32)
+
+        def body(i, carry):
+            st, outs = carry
+            # substeps >= 1 refresh MY gathered row from the advancing
+            # state; peers' rows stay frozen for the whole dispatch —
+            # exactly N serial ticks during which no peer frame lands.
+            # Substep 0 consumes gvec verbatim (bit-parity with N=1
+            # even when the caller's self row is stale).
+            g = jax.tree.map(
+                lambda gl, bl: jnp.where(i > 0, gl.at[my_id].set(bl), gl),
+                gathered0, make_blob(st),
+            )
+            req_i = lax.dynamic_index_in_dim(
+                req_ring, i, axis=0, keepdims=False
+            )
+            want_i = want_coord & (i == 0)
+            st, out = step(st, g, heard, req_i, want_i, my_id, cfg=cfg)
+            outs = lax.dynamic_update_index_in_dim(
+                outs, _pack_out(out), i, axis=0
+            )
+            return st, outs
+
+        new_state, out_rings = lax.fori_loop(
+            0, n_steps, body, (state, out0)
+        )
+        blob_vec = pack_blob(make_blob(new_state))
+        return (
+            _constrain(mesh, new_state, GROUP_AXIS), out_rings, blob_vec,
+        )
+
+    return run_n
+
+
+@functools.lru_cache(maxsize=None)
+def _make_step_cached(cfg, mesh, steps_per_dispatch, donate, io):
+    if steps_per_dispatch < 1:
+        raise ValueError("steps_per_dispatch must be >= 1")
+    if io == "stacked":
+        return _build_stacked(cfg, mesh, steps_per_dispatch, donate)
+    if io == "packed_host":
+        return _build_packed(cfg, mesh, steps_per_dispatch, donate)
+    raise ValueError(f"unknown io flavor: {io!r}")
+
+
+def make_step(cfg: EngineConfig, mesh: Optional[Mesh] = None,
+              steps_per_dispatch: int = 1, *, donate: bool = True,
+              io: str = "stacked"):
+    """Build THE consensus step: mesh-parameterized, N-steps-resident.
+
+    Parameters
+    ----------
+    cfg : EngineConfig (static — one compile per config)
+    mesh : None for single-device; a ``(g, r)`` or ``('g',)``
+        :class:`jax.sharding.Mesh` to pin the GSPMD partitioning (the
+        program is the same; only the auto-partitioning changes, so
+        results are bit-identical across meshes — all-int32 arithmetic).
+    steps_per_dispatch : N >= 1 consensus rounds per host call over
+        device-resident request/response rings (``ENGINE_STEPS_PER_
+        DISPATCH``).  N == 1 compiles the exact legacy single-step
+        program.
+    donate : alias the caller's old state buffers into the new state
+        (halves state HBM — the G=2M capacity lever); pass ``False``
+        when input states must stay valid across calls.
+    io : ``"stacked"`` ([R, ...] SPMD/bench face) or ``"packed_host"``
+        (one replica + packed [R, NB] gathered vectors — the deployed
+        runtime's face; see the module docstring for signatures).
+
+    Instances are memoized: the same (cfg, mesh, N, donate, io) returns
+    the same callable, so jit caches are shared across managers.
+    """
+    return _make_step_cached(
+        cfg, mesh, int(steps_per_dispatch), bool(donate), str(io)
+    )
+
+
+# ---------------------------------------------------------------------------
+# deprecated thin aliases over the factory (pre-factory entry points)
+# ---------------------------------------------------------------------------
+
+
+def single_chip_step(cfg: EngineConfig, donate: bool = True):
+    """Deprecated alias: ``make_step(cfg, None, 1, donate=donate)``.
+
+    All R replica states stacked on one device and advanced with vmap;
+    the "gather" is the stacked blobs (the loopback/bench mode — the
+    analog of the reference's N-nodes-in-one-JVM testing mode,
+    ``PaxosManager.java:108-111``)."""
+    return make_step(cfg, None, 1, donate=donate)
 
 
 def spmd_step(cfg: EngineConfig, mesh: Mesh):
-    """shard_map step over the (g, r) mesh.
+    """Deprecated alias: ``make_step(cfg, mesh, 1)`` over the (g, r)
+    mesh (acceptor-per-chip; blob exchange = all_gather over 'r').
 
-    Global args: states [R, G, ...] with P('r', 'g'); req_vid [R, G, K];
-    want_coord [R, G]; heard (optional) [R(recv), R(send)] bool delivery
-    matrix, sharded P('r', None) so each replica shard carries its own
-    receive row.  Each shard holds [1, G/gs, ...]; the replica-axis blob
-    exchange is one all_gather per step on ICI.  A dropped peer is a heard
-    row entry set False: the all_gather still runs (the collective is
-    membership-oblivious, like the reference's NIO multicast to a crashed
-    node) and the engine masks the dead peer's blob out of every quorum
-    (ref fault model: ``testing/TESTPaxosConfig.java:563-580``).  The
-    diagonal is forced — a replica always hears itself.
-    """
-    R = cfg.n_replicas
-    rg = P(REPLICA_AXIS, GROUP_AXIS)
-    state_spec = EngineState(*([rg] * len(EngineState._fields)))
-    out_spec = StepOutputs(*([rg] * len(StepOutputs._fields)))
-
-    n_shards = mesh.shape[GROUP_AXIS]
-    if cfg.n_groups % n_shards:
+    Keeps the historical divisibility contract: the (g, r) deployment
+    pins G/gs groups per chip, so a non-divisible G is a config error
+    here (the factory itself accepts any G — GSPMD pads internally)."""
+    if cfg.n_groups % mesh.shape[GROUP_AXIS]:
         raise ValueError("n_groups must divide evenly over the group axis")
-    local_cfg = cfg._replace(n_groups=cfg.n_groups // n_shards)
+    return make_step(cfg, mesh, 1)
 
-    @partial(
-        shard_map,
-        mesh=mesh,
-        in_specs=(
-            state_spec,
-            P(REPLICA_AXIS, GROUP_AXIS, None),
-            P(REPLICA_AXIS, GROUP_AXIS),
-            P(REPLICA_AXIS, None),
-        ),
-        out_specs=(state_spec, out_spec),
-        **_SHARD_MAP_CHECK_KW,
-    )
-    def _sharded(states, req_vid, want_coord, heard):
-        # local shapes: leaves [1, G_loc, ...]; heard [1, R]
-        state = jax.tree.map(lambda x: x[0], states)
-        # the exchange payload is the COMPACT blob (4 [G] + 4 [G, W] int32
-        # leaves vs the state's 12 + 7): the all_gather moves ~42% fewer
-        # ICI bytes per step than the pre-compact layout
-        blob = make_blob(state)
-        gathered = jax.tree.map(lambda x: lax.all_gather(x, REPLICA_AXIS), blob)
-        my_id = lax.axis_index(REPLICA_AXIS).astype(jnp.int32)
-        heard_row = heard[0] | (jnp.arange(R) == my_id)
-        new_state, out = step(
-            state, gathered, heard_row, req_vid[0], want_coord[0], my_id,
-            local_cfg,
-        )
-        expand = lambda x: x[None]
-        return jax.tree.map(expand, new_state), jax.tree.map(expand, out)
 
-    # donate the global state shards (see single_chip_step)
-    fn = jax.jit(_sharded, donate_argnums=(0,))
+def group_sharded_step(cfg: EngineConfig, mesh: Mesh, donate: bool = True):
+    """Deprecated alias: ``make_step(cfg, mesh, 1, donate=donate)`` over
+    the 1-D ('g',) mesh — G partitioned, R device-local, zero
+    cross-device collectives (the weak-scaling shape).  Pad G to a mesh
+    multiple first (``pad_group_states`` / ``shard_group_inputs``) to
+    keep per-device slices even."""
+    return make_step(cfg, mesh, 1, donate=donate)
 
-    def run(states, req_vid, want_coord, heard=None):
-        if heard is None:
-            heard = jnp.ones((R, R), bool)
-        return fn(states, req_vid, want_coord, jnp.asarray(heard, bool))
 
-    return run
+# ---------------------------------------------------------------------------
+# input placement helpers (unchanged layouts)
+# ---------------------------------------------------------------------------
 
 
 def replicate_inputs(mesh: Mesh, states: EngineState, req_vid, want_coord):
-    """Device_put global inputs with the canonical shardings."""
+    """Device_put global inputs with the canonical (g, r) shardings."""
     sh = lambda spec: NamedSharding(mesh, spec)
     states = jax.tree.map(
         lambda x: jax.device_put(x, sh(P(REPLICA_AXIS, GROUP_AXIS))), states
@@ -187,13 +377,6 @@ def replicate_inputs(mesh: Mesh, states: EngineState, req_vid, want_coord):
     req_vid = jax.device_put(req_vid, sh(P(REPLICA_AXIS, GROUP_AXIS, None)))
     want_coord = jax.device_put(want_coord, sh(P(REPLICA_AXIS, GROUP_AXIS)))
     return states, req_vid, want_coord
-
-
-# ---------------------------------------------------------------------------
-# Group-sharded SPMD: the G axis partitioned over ALL mesh devices, every
-# device holding all R replica rows for its slice — zero cross-device
-# collectives (see the module docstring).
-# ---------------------------------------------------------------------------
 
 
 def padded_group_count(n_groups: int, n_shards: int) -> int:
@@ -250,7 +433,8 @@ def shard_group_inputs(mesh: Mesh, cfg: EngineConfig, states: EngineState,
                        req_vid, want_coord):
     """Pad to the mesh's shard count and device_put with the group-sharded
     layout: states/want ``P(None, 'g')``, requests ``P(None, 'g', None)``.
-    Returns (states, req_vid, want_coord) ready for group_sharded_step."""
+    Returns (states, req_vid, want_coord) ready for the group-sharded
+    step."""
     n_shards = mesh.shape[GROUP_AXIS]
     states = pad_group_states(cfg, states, n_shards)
     req_vid, want_coord = pad_group_inputs(cfg, n_shards, req_vid, want_coord)
@@ -261,62 +445,3 @@ def shard_group_inputs(mesh: Mesh, cfg: EngineConfig, states: EngineState,
     req_vid = jax.device_put(req_vid, sh(P(None, GROUP_AXIS, None)))
     want_coord = jax.device_put(want_coord, sh(P(None, GROUP_AXIS)))
     return states, req_vid, want_coord
-
-
-def group_sharded_step(cfg: EngineConfig, mesh: Mesh, donate: bool = True):
-    """shard_map step over a 1-D ('g',) mesh: G partitioned, R device-local.
-
-    Global args: states [R, Gp, ...] with ``P(None, 'g')`` (Gp = G padded
-    up to a multiple of the mesh, ``pad_group_states``); req_vid
-    [R, Gp, K]; want_coord [R, Gp]; heard (optional) [R(recv), R(send)]
-    bool delivery matrix, replicated (every shard applies the same fault
-    pattern — the host FD is per-node, not per-group-shard).
-
-    Each shard runs the single-chip vmap step over its [R, Gp/n, ...]
-    slice: the blob "exchange" is the locally stacked blobs, so the body
-    contains NO collectives — the compiled step is pure per-device work
-    and weak-scales linearly by construction.  ``donate=True`` aliases
-    the old state shards into the new ones (per-device HBM stays
-    ``bytes_per_group x Gp / n_shards``, one copy)."""
-    R = cfg.n_replicas
-    n_shards = mesh.shape[GROUP_AXIS]
-    Gp = padded_group_count(cfg.n_groups, n_shards)
-    local_cfg = cfg._replace(n_groups=Gp // n_shards)
-    my_ids = jnp.arange(R, dtype=jnp.int32)
-
-    gspec = P(None, GROUP_AXIS)
-    state_spec = EngineState(*([gspec] * len(EngineState._fields)))
-    out_spec = StepOutputs(*([gspec] * len(StepOutputs._fields)))
-
-    @partial(
-        shard_map,
-        mesh=mesh,
-        in_specs=(
-            state_spec,
-            P(None, GROUP_AXIS, None),
-            P(None, GROUP_AXIS),
-            P(None, None),
-        ),
-        out_specs=(state_spec, out_spec),
-        **_SHARD_MAP_CHECK_KW,
-    )
-    def _sharded(states, req_vid, want_coord, heard):
-        # local shapes: leaves [R, Gp/n, ...]; heard [R, R] (replicated)
-        h = heard | jnp.eye(R, dtype=bool)
-        blobs = jax.vmap(make_blob)(states)
-
-        def _one(state, heard_row, req, want, my_id):
-            return step(state, blobs, heard_row, req, want, my_id, local_cfg)
-
-        return jax.vmap(_one, in_axes=(0, 0, 0, 0, 0))(
-            states, h, req_vid, want_coord, my_ids
-        )
-
-    fn = jax.jit(_sharded, donate_argnums=(0,) if donate else ())
-
-    def run(states, req_vid, want_coord, heard=None):
-        if heard is None:
-            heard = jnp.ones((R, R), bool)
-        return fn(states, req_vid, want_coord, jnp.asarray(heard, bool))
-
-    return run

@@ -212,13 +212,23 @@ def settle_and_audit(c: ReconfigurableCluster, names, step,
             },
         )
 
-    # record agreement across RCs
+    # record agreement across RCs — poll-bounded like the READY-align
+    # and RSM checks below: settle gates on RC0's records only, and a
+    # sibling RC executing its paxos log in dispatch-sized bursts
+    # (ENGINE_STEPS_PER_DISPATCH > 1) can be one exchange behind at the
+    # instant settle flips.  A real fork never converges and still
+    # lands here; a replica mid-catch-up is not end state.
     for nm in names:
-        views = [rc.rc_app.get_record(nm) for rc in c.reconfigurators]
-        datas = [None if v is None else v.to_json() for v in views]
-        if not all(d == datas[0] for d in datas):
-            raise _divergence(c, "RC record disagreement",
-                              {"name": nm, "views": datas})
+        agree_deadline = time.time() + 30
+        while True:
+            views = [rc.rc_app.get_record(nm) for rc in c.reconfigurators]
+            datas = [None if v is None else v.to_json() for v in views]
+            if all(d == datas[0] for d in datas):
+                break
+            if time.time() > agree_deadline:
+                raise _divergence(c, "RC record disagreement",
+                                  {"name": nm, "views": datas})
+            step()
 
     for nm, rec in recs.items():
         if rec is None or rec.deleted:
@@ -230,9 +240,13 @@ def settle_and_audit(c: ReconfigurableCluster, names, step,
             # (wall-timer-gated), so a step-count cap alone can burn
             # through on a fast box before the timers the heal needs
             # have fired
-            drop_deadline = time.time() + 6 * max(
+            # floor at 30s: the redrop only fires once per audit period,
+            # and a slow process (cold jax compiles, multi-step
+            # dispatches) can burn a small multiple of the period on the
+            # steps BETWEEN firings; healthy runs exit this poll early
+            drop_deadline = time.time() + max(30.0, 6 * max(
                 rc.ready_audit_period_s for rc in c.reconfigurators
-            )
+            ))
             while time.time() < drop_deadline:
                 if all(m.names.get(nm) is None for m in c.ars.managers):
                     break

@@ -18,9 +18,21 @@ f-string first argument) must appear in ``METRICS.md``, and every name
 documented there must exist in code.  Dynamically-labeled series
 (f-strings like ``probe_rtt_ms_active_{id}``) are documented with a
 ``*`` wildcard (``probe_rtt_ms_active_*``) and matched by their literal
-prefix.  Run standalone (exit 1 on violations) or through the tier-1
-test ``tests/test_obs.py::test_obs_hygiene_gate`` so future code stays
-on the logging plane and the inventory stays true.
+prefix.
+
+Third pass (the hot-path pull gate): ``_np("leaf")`` device pulls
+inside the tick/dispatch hot path — the functions named in
+``HOT_NP_ALLOW`` — must stay within each function's allowlist.  A pull
+is a device sync: one stray ``_np("bal")`` added to the per-tick path
+once wedged a pinned chaos seed for minutes of wall time (the ballot
+cache exists precisely so the hot path never re-pulls it).  Adding a
+pull to a hot function means consciously widening the allowlist here,
+with the latency argument in the PR.
+
+Run standalone (exit 1 on violations) or through the tier-1 test
+``tests/test_obs.py::test_obs_hygiene_gate`` so future code stays on
+the logging plane, the inventory stays true, and the hot path stays
+pull-free.
 """
 
 from __future__ import annotations
@@ -35,6 +47,27 @@ PACKAGE = "gigapaxos_tpu"
 EXEMPT_TOP_DIRS = ("obs",)
 METRIC_METHODS = ("count", "gauge", "observe")
 METRICS_DOC = "METRICS.md"
+
+# The tick/dispatch hot path: every `_np("leaf")` pull these functions
+# are ALLOWED to make.  An empty set means the function must never pull
+# (the dispatch cycle's device traffic is exactly the packed I/O
+# buffers).  A dynamic (non-literal) pull argument in any hot function
+# is always a violation.
+HOT_NP_ALLOW = {
+    ("manager.py", "step_dispatch"): frozenset(),
+    ("manager.py", "step_complete"): frozenset(),
+    ("manager.py", "_tick_host_locked"): frozenset(),
+    ("manager.py", "_tick_locked"): frozenset(),
+    ("manager.py", "_execute"): frozenset(),
+    ("manager.py", "_execute_one"): frozenset({"version"}),
+    ("manager.py", "build_request_ring"): frozenset({"bal", "version"}),
+    ("manager.py", "_filter_stale_vids"): frozenset({"version"}),
+    ("manager.py", "_post_step_locked"): frozenset(
+        {"bal", "member_mask", "acc_slot", "acc_bal", "acc_vid"}
+    ),
+    ("server.py", "_should_tick"): frozenset({"bal", "member_mask"}),
+    ("server.py", "_tick_once_inner"): frozenset({"bal", "member_mask"}),
+}
 
 
 def _stream_write(func: ast.AST) -> bool:
@@ -150,12 +183,55 @@ def iter_inventory_violations(
                "code registers it")
 
 
+def iter_hot_np_violations(
+    pkg_root: pathlib.Path,
+) -> Iterator[Tuple[str, int, str]]:
+    """Hot-path pull gate: ``_np(...)`` calls inside the functions named
+    in ``HOT_NP_ALLOW`` must pull only their allowlisted leaves."""
+    files = {fname for fname, _ in HOT_NP_ALLOW}
+    for path in sorted(pkg_root.rglob("*.py")):
+        if path.name not in files:
+            continue
+        rel = path.relative_to(pkg_root)
+        tree = ast.parse(path.read_text(encoding="utf-8"),
+                         filename=str(path))
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            allow = HOT_NP_ALLOW.get((path.name, node.name))
+            if allow is None:
+                continue
+            for call in ast.walk(node):
+                if not isinstance(call, ast.Call):
+                    continue
+                fn = call.func
+                fn_name = fn.attr if isinstance(fn, ast.Attribute) \
+                    else getattr(fn, "id", None)
+                if fn_name != "_np":
+                    continue
+                arg = call.args[0] if call.args else None
+                if isinstance(arg, ast.Constant) and \
+                        isinstance(arg.value, str):
+                    if arg.value in allow:
+                        continue
+                    yield (str(rel), call.lineno,
+                           f"_np({arg.value!r}) in hot path "
+                           f"{node.name}() — a device pull per "
+                           "tick/dispatch; widen HOT_NP_ALLOW only with "
+                           "a latency argument")
+                else:
+                    yield (str(rel), call.lineno,
+                           f"dynamic _np(...) in hot path {node.name}() "
+                           "— pulls must be literal and allowlisted")
+
+
 def main(argv=None) -> int:
     root = pathlib.Path(
         (argv or sys.argv[1:] or [None])[0]
         or pathlib.Path(__file__).resolve().parent.parent / PACKAGE
     )
     bad = list(iter_violations(root))
+    bad += list(iter_hot_np_violations(root))
     for rel, line, why in bad:
         print(f"{PACKAGE}/{rel}:{line}: {why}")
     inv = list(iter_inventory_violations(root, root.parent / METRICS_DOC))
@@ -164,7 +240,7 @@ def main(argv=None) -> int:
     if bad or inv:
         print(f"{len(bad) + len(inv)} obs-hygiene violation(s)")
         return 1
-    print("obs hygiene clean (streams + metric inventory)")
+    print("obs hygiene clean (streams + metric inventory + hot-path pulls)")
     return 0
 
 
