@@ -704,7 +704,17 @@ class PaxosManager:
                     arrays["n_execd"][r] = int(prec["n_execd"])
                     self.app.restore(nm, prec.get("app_state"))
                     fold_restored.add(nm)
-                    self.app_exec_slot[r] = int(prec["exec"])
+                    # the snapshotted app state corresponds to the
+                    # record's APP cursor, which a forced pause can leave
+                    # behind the device frontier; pairing the state with
+                    # "exec" would skip the gap's executions silently.
+                    # The stranded gap is unexecutable locally (see the
+                    # resume_group comment) — park for a donor pull
+                    self.app_exec_slot[r] = int(
+                        prec.get("app_exec", prec["exec"])
+                    )
+                    if int(self.app_exec_slot[r]) < int(prec["exec"]):
+                        self._needs_state.add(r)
                     self.pending_exec.pop(r, None)
                     for rid_s, ent in (prec.get("dedup") or {}).items():
                         self.response_cache.setdefault(
@@ -875,7 +885,13 @@ class PaxosManager:
     def local_read_ok(self, name: str) -> bool:
         """Gate for the uncoordinated local-read fast path: False while
         the name's app state is un-hydrated (and promotes it to the
-        front of the hydration queue — a request touched it)."""
+        front of the hydration queue — a request touched it), and False
+        while a transaction holds the name locked/staged (txn/app.py) —
+        the read then serializes through consensus, where it is refused
+        retryably until the transaction's decision lands."""
+        blocked = getattr(self.app, "txn_local_read_blocked", None)
+        if blocked is not None and blocked(name):
+            return False
         row = self.names.get(name)
         if row is None or row not in self.hydrating_rows:
             return True
@@ -1434,6 +1450,34 @@ class PaxosManager:
             )
             self.app_exec_slot[r] = int(rec.get("app_exec", rec["exec"]))
             self._app_exec_dirty.add(r)
+            if int(self.app_exec_slot[r]) < int(rec["exec"]):
+                # a FORCED pause snapshots non-quiescent rows, so the
+                # record can carry app_exec < exec — but the decided
+                # slots in between are in NEITHER the record (dec
+                # remnants keep only >= exec) nor pending_exec (dropped
+                # with the pause).  The cursor can never replay its way
+                # forward, and the gap may sit under jump_horizon with
+                # nothing payload-blocked, so no heal detector fires
+                # (txn-soak find: a hibernated-mid-traffic member woke
+                # with app_exec 24 slots behind a current device
+                # frontier and stayed there forever).  Park the row as
+                # needing donor state — the per-tick state pull + the
+                # app_only adoption clause close the gap
+                self._needs_state.add(r)
+            # same reasoning as the rejoin purge above: the resume ROLLS
+            # BACK to the snapshot, so this member's own response-cache
+            # entries for executions AFTER the snapshot describe state
+            # the restored app does not contain — kept, they would
+            # skip-execute those decisions during catch-up and diverge
+            # the RSM (txn-soak find: a forced mid-traffic hibernate on
+            # one member, woken as a straggler, came back short one
+            # committed transfer).  The snapshot's own paired dedup
+            # reinstalls right below.
+            for rid in [
+                r2 for r2, (_t, _resp, nm) in self.response_cache.items()
+                if nm == name
+            ]:
+                del self.response_cache[rid]
             self.install_dedup(rec.get("dedup"))
             # the _create_locked journal entry has the app state as init;
             # the consensus remnants need the pause record on replay too
@@ -1450,6 +1494,27 @@ class PaxosManager:
                     self.vid_scope[v] = (
                         (str(sc[0]), int(sc[1])) if sc else (name, int(epoch))
                     )
+            # release ORPHANED vids: a proposal admitted from the queue
+            # into the device ring before a FORCED pause is in neither
+            # the held queue nor the record's window remnants — the
+            # consensus copy is gone, but its scheduling state survived
+            # the pause (release_queue=False).  Kept, the stale
+            # inflight entry parks every retransmit of that request id
+            # here AND poisons forward-dedup of fresh peer proposals
+            # for the same id, wedging the group on it forever
+            # (txn-soak find: a resolver's commit re-drive starved
+            # through 4k+ retransmits).  Undecided-only: remnant and
+            # retained (decided) vids keep their state
+            # re-homed/preempted vids can sit in OTHER rows' queues —
+            # anything still queued anywhere is live, not orphaned
+            kept = {v for q in self.queues.values() for v in q}
+            kept.update(v for _s, _b, v in (rec.get("acc") or []))
+            kept.update(v for _s, v in (rec.get("dec") or []))
+            for v in [
+                v for v, (nm, _ep) in self.vid_scope.items()
+                if nm == name and v not in kept and v not in self.retained
+            ]:
+                self._release_vid(v)
             self.row_activity[r] = time.time()
             return True
 
@@ -1824,7 +1889,8 @@ class PaxosManager:
             )
             response = getattr(req, "response_value", None)
             with self._state_lock:
-                self._cache_response(request_id, response, name)
+                if self._cacheable(req):
+                    self._cache_response(request_id, response, name)
                 self.total_executed += 1
                 self.row_activity[row] = time.time()
                 self._emulating.discard(request_id)
@@ -2944,6 +3010,17 @@ class PaxosManager:
         if len(self.response_cache) > self.response_cache_cap:
             self._evict_response_cache()
 
+    @staticmethod
+    def _cacheable(req) -> bool:
+        """False for RETRYABLE refusals (``req.txn_retry``, set by the
+        transaction plane when a request bounces off a locked group):
+        caching one would freeze the refusal under exactly-once dedup
+        and the same request id could never succeed after the lock
+        clears.  Deterministic across replicas — the refusal is computed
+        from replicated lock state and mutates nothing, so every member
+        skips the cache for the same decided entry."""
+        return not getattr(req, "txn_retry", False)
+
     def _evict_response_cache(self) -> None:
         """Size bound (RESPONSE_CACHE_SIZE analog): evict the oldest
         tenth so the cache (and its state-transfer ride-along) stays
@@ -3004,7 +3081,8 @@ class PaxosManager:
                                      **self._tc_detail(tc))
                 self.inflight.pop(request_id, None)
                 response = req.response_value
-                rc[request_id] = (now, response, nm)
+                if self._cacheable(req):
+                    rc[request_id] = (now, response, nm)
                 if entry == my:
                     cb = self.outstanding.pop(request_id)
                     if cb is not None:
@@ -3050,7 +3128,8 @@ class PaxosManager:
         # dedup set lacks the stop's own entry is an inconsistent pair
         # (chaos-sweep forensics: every breach diff was missing exactly
         # one epoch-final stop id)
-        self._cache_response(request_id, response, name or "")
+        if self._cacheable(req):
+            self._cache_response(request_id, response, name or "")
         if (vid & STOP_BIT) and self.on_stop_executed is not None and name:
             epoch = int(self._np("version")[g])
             try:

@@ -100,7 +100,14 @@ class FlightRecorder:
         the path (None only if the write itself failed — the recorder
         must never take the node down with it).  ``once=True`` dedups by
         reason (the tick-loop exception hook fires per tick while a bug
-        persists; one dump per reason is the useful artifact)."""
+        persists; one dump per reason is the useful artifact).
+
+        ``reason`` should be a structured slug (``divergence.<kind>``,
+        ``tick-exception``) and ``extra`` the attribution a post-mortem
+        needs WITHOUT the producing process — soak family, seed,
+        divergence kind, offending group/name.  A bare
+        ``reason="divergence"`` dump is unattributable once the run's
+        stdout is gone (the pre-r17 repo carried 84 of those)."""
         if once:
             with self._lock:
                 if reason in self._dumped_reasons:
@@ -124,6 +131,32 @@ class FlightRecorder:
             with open(tmp, "w") as f:
                 json.dump(doc, f, indent=1)
             os.replace(tmp, path)  # a torn dump must not look complete
-            return path
         except OSError:
             return None
+        self._rotate(dir_)
+        return path
+
+    @staticmethod
+    def _rotate(dir_: str) -> None:
+        """Cap the dump directory at ``FLIGHT_MAX_DUMPS`` files, oldest
+        out first, so repeated local soak runs stop accumulating
+        unbounded JSON (0 disables).  Best-effort: rotation must never
+        fail a dump."""
+        try:
+            cap = Config.get_int(PC.FLIGHT_MAX_DUMPS)
+        except Exception:
+            cap = 0
+        if cap <= 0:
+            return
+        try:
+            files = [
+                os.path.join(dir_, f) for f in os.listdir(dir_)
+                if f.startswith("flight_") and f.endswith(".json")
+            ]
+            if len(files) <= cap:
+                return
+            files.sort(key=lambda p: os.path.getmtime(p))
+            for p in files[:len(files) - cap]:
+                os.remove(p)
+        except OSError:
+            pass

@@ -108,6 +108,22 @@ class PC(FlagEnum):
     FLIGHT_STEPS = 512
     FLIGHT_DECIDED = 1024
     FLIGHT_DIR = "flight_dumps"
+    # per-directory dump cap: after each dump the oldest files beyond
+    # this count are rotated out, so repeated local soak runs stop
+    # accumulating unbounded JSON in the repo root (0 disables rotation)
+    FLIGHT_MAX_DUMPS = 64
+
+    # ---- transactions (txn/: sorted 2PC-over-Paxos) --------------------
+    # driver budget from begin to all-prepared, and the resolver's
+    # presumed-abort horizon for undecided coordinator records — LOGICAL
+    # seconds (the soak clock is step-driven and compressed)
+    TXN_PREPARE_TIMEOUT_S = 5.0
+    # resolver cadence: how often the in-doubt resolver scans the
+    # coordinator group for records to re-drive or presume-abort
+    TXN_RESOLVE_PERIOD_S = 1.0
+    # concurrent transactions a driver pool keeps in flight (soak and
+    # bank-ledger workload concurrency bound)
+    TXN_MAX_INFLIGHT = 32
 
     # ---- recovery plane (new; restart-to-serving SLO) ------------------
     # checkpoint sharding: >1 splits every snapshot into this many

@@ -654,6 +654,15 @@ class PaxosServer:
                 "profiler": DelayProfiler.get_snapshot(),
                 "profiler_line": DelayProfiler.get_stats(),
             }
+            # transaction plane (txn/app.py): live lock/staged/record
+            # counts — a stuck in-doubt transaction shows up here long
+            # before an audit trips over its lock
+            txn_stats = getattr(self.manager.app, "txn_stats", None)
+            if txn_stats is not None:
+                try:
+                    out["txn"] = txn_stats()
+                except Exception:
+                    pass  # stats must never fail the admin plane
             layer = self._layer_stats()
             if layer:
                 out["layer"] = layer
@@ -714,7 +723,9 @@ class PaxosServer:
                 # write a dump per tick)
                 try:
                     path = self.manager.flight.dump(
-                        reason="tick-exception", once=True
+                        reason="tick-exception", once=True,
+                        extra={"where": "server-tick-loop",
+                               "node": self.my_id, "tick": self._tick},
                     )
                     if path:
                         self.log.warning("flight recorder dumped to %s",

@@ -23,9 +23,13 @@ dedup diagnostics so a failing seed is actionable, not just red.
 
 from __future__ import annotations
 
+import json
+import os
 import random
+import shutil
+import tempfile
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from ..models.apps import HashChainApp
 from ..ops.engine import EngineConfig
@@ -42,13 +46,22 @@ class SoakDivergence(AssertionError):
         self.diag = diag or {}
 
 
-def _flight_dump_all(c: ReconfigurableCluster, reason: str) -> List[str]:
-    """Dump every AR member's flight recorder (obs/flight.py) for a
+def _soak_managers(c) -> List:
+    """The member managers of either cluster flavor: a
+    ReconfigurableCluster (``c.ars.managers``) or a bare ManagerCluster
+    (``c.managers`` — the txn soak's harness)."""
+    ars = getattr(c, "ars", None)
+    return ars.managers if ars is not None else getattr(c, "managers", [])
+
+
+def _flight_dump_all(c, reason: str,
+                     extra: Optional[Dict] = None) -> List[str]:
+    """Dump every member's flight recorder (obs/flight.py) for a
     divergence post-mortem; returns the on-disk paths."""
     paths = []
-    for m in c.ars.managers:
+    for m in _soak_managers(c):
         try:
-            p = m.flight.dump(reason=reason)
+            p = m.flight.dump(reason=reason, extra=extra)
         except Exception:
             p = None
         if p:
@@ -56,14 +69,32 @@ def _flight_dump_all(c: ReconfigurableCluster, reason: str) -> List[str]:
     return paths
 
 
-def _divergence(c: ReconfigurableCluster, msg: str,
-                diag: Optional[Dict] = None) -> SoakDivergence:
+def _divergence(c, msg: str, diag: Optional[Dict] = None,
+                kind: Optional[str] = None) -> SoakDivergence:
     """Build a SoakDivergence WITH the black box attached: every
     member's flight-recorder rings land on disk and the paths ride the
     failure diagnostics — the strict-sweep contract that every residual
-    breach is post-mortemable from the artifact alone."""
+    breach is post-mortemable from the artifact alone.
+
+    The dump carries a STRUCTURED reason (``divergence.<kind>``) plus
+    the soak's attribution context (family, seed — ``c._soak_ctx``, set
+    by every ``run_*soak``) and the offending name/group, so a dump
+    found on disk weeks later still says which soak family and seed
+    produced it and what invariant broke."""
     diag = dict(diag or {})
-    diag["flight_dumps"] = _flight_dump_all(c, reason="divergence")
+    if kind is None:
+        kind = "-".join(
+            "".join(ch for ch in w.lower() if ch.isalnum())
+            for w in msg.split()[:4]
+        ).strip("-") or "unknown"
+    ctx = dict(getattr(c, "_soak_ctx", None) or {})
+    extra = {**ctx, "kind": kind, "msg": msg}
+    for key in ("name", "member", "shard", "txid"):
+        if key in diag:
+            extra[key] = diag[key]
+    diag["flight_dumps"] = _flight_dump_all(
+        c, reason=f"divergence.{kind}", extra=extra
+    )
     return SoakDivergence(msg, diag)
 
 
@@ -393,6 +424,7 @@ def run_soak(
         )
         n_ar = ar_cfg.n_replicas
         c = ReconfigurableCluster(ar_cfg, rc_cfg, HashChainApp)
+        c._soak_ctx = {"family": "core", "seed": seed}
         # soaks always trace: the whole point of a soak failure is the
         # forensics, and the stepped cluster has no hot-path budget to
         # protect — a SoakDivergence then carries each member's recent
@@ -550,6 +582,8 @@ def run_sharded_soak(
 
         for _w in range(workers):
             c = ReconfigurableCluster(ar_cfg, rc_cfg, HashChainApp)
+            c._soak_ctx = {"family": "sharded", "seed": seed,
+                           "shard": _w}
             for m in c.ars.managers:
                 m.tracer.enabled = True
             for rc in c.reconfigurators:
@@ -662,3 +696,326 @@ def run_sharded_soak(
         Config.clear()
         for cls, p in zip(task_classes, saved_periods):
             cls.restart_period_s = p
+
+
+def run_txn_soak(
+    seed: int,
+    *,
+    rounds: int = 400,
+    n_accounts: int = 8,
+    n_replicas: int = 3,
+    max_inflight: int = 4,
+    spawn_rate: float = 0.25,
+    kill_rate: float = 0.02,
+    loss: float = 0.1,
+    partition_rate: float = 0.01,
+    restart_rate: float = 0.006,
+    pause_rate: float = 0.01,
+    initial_balance: int = 100,
+    amount_max: int = 9,
+    zipf_alpha: float = 1.1,
+    settle_budget_s: float = 420.0,
+) -> Dict:
+    """The transaction chaos family: sorted 2PC-over-Paxos under fire.
+
+    A bank of ``n_accounts`` ledger groups (StatefulAdderApp under
+    TxnApp, every balance starting at ``initial_balance``) takes Zipfian
+    two-account transfers (hot-head contention) from up to
+    ``max_inflight`` concurrent :class:`~..txn.TxnDriver`\\ s while the
+    cluster suffers message loss, timed single-member partitions,
+    crash-restarts from the journal (``ManagerCluster.restart``), and
+    per-member hibernate/restore of account groups — and drivers are
+    KILLED mid-protocol at ``kill_rate`` per round, leaving in-doubt
+    transactions for the :class:`~..txn.TxnResolver` (presumed abort) to
+    resolve.
+
+    End-state audit (raises :class:`SoakDivergence`):
+
+    * every driver finishes and the resolver drains (no live coordinator
+      records, no re-drives in flight) within the settle budget;
+    * no participant lock or staged op survives on ANY replica;
+    * every killed driver's transaction has ONE global outcome at the
+      coordinator, and the committed ones are folded into the ledger;
+    * replicas agree on every balance (RSM convergence);
+    * conservation: the balances sum to exactly
+      ``n_accounts * initial_balance`` (transfers move money, never mint
+      or burn it) — atomicity across groups in one number;
+    * per-name linearizability: each balance equals ``initial_balance``
+      plus the sum of COMMITTED deltas for that name — an aborted
+      transaction that leaked a staged op, or a commit applied twice,
+      lands here.
+
+    All protocol pacing runs on the LOGICAL clock (``steps * 0.05``, the
+    chaos-compressed convention) — wall time only bounds the settle loop.
+    """
+    import numpy as np
+
+    from ..models.apps import StatefulAdderApp
+    from ..txn import (ABORTED, COMMITTED, TXN_COORD, Transaction, TxnApp,
+                       TxnDriver, TxnResolver, txc_op)
+    from .cluster import DELIVER, DROP, ManagerCluster
+
+    c = None
+    tmp = None
+    try:
+        # exactly-once within the TTL only; pin it wide (soak convention)
+        Config.set("RESPONSE_CACHE_TTL_S", "3600")
+        # the soak's concurrency never exceeds the deployed driver cap
+        from ..paxos_config import PC
+        max_inflight = min(max_inflight, Config.get_int(PC.TXN_MAX_INFLIGHT))
+        rng = random.Random(seed)
+        cfg = EngineConfig(n_groups=16, window=8, req_lanes=4,
+                           n_replicas=n_replicas)
+        tmp = tempfile.mkdtemp(prefix=f"txnsoak{seed}_")
+        c = ManagerCluster(
+            cfg, lambda: TxnApp(StatefulAdderApp()),
+            log_dirs=[os.path.join(tmp, f"n{r}")
+                      for r in range(n_replicas)],
+            checkpoint_every=8,
+        )
+        c._soak_ctx = {"family": "txn", "seed": seed}
+        for m in c.managers:
+            m.tracer.enabled = True
+        accounts = [f"acct{i}" for i in range(n_accounts)]
+        c.create(TXN_COORD)
+        for nm in accounts:
+            c.create(nm, initial_state=str(initial_balance))
+
+        STEP_DT = 0.05
+        steps = [0]
+
+        def clock() -> float:
+            return steps[0] * STEP_DT
+
+        part = {"until": -1, "cut": frozenset()}
+        chaos = [True]
+
+        def delivery() -> np.ndarray:
+            R = n_replicas
+            d = np.full((R, R), DELIVER)
+            if not chaos[0]:
+                return d
+            cut = part["cut"] if steps[0] < part["until"] else frozenset()
+            for i in range(R):
+                for j in range(R):
+                    if i == j:
+                        continue
+                    if (i in cut) != (j in cut) or rng.random() < loss:
+                        d[i, j] = DROP
+            return d
+
+        def step() -> None:
+            c.step_all(delivery())
+            steps[0] += 1
+
+        def submit(name, value, rid, cb) -> None:
+            c.managers[rng.randrange(n_replicas)].propose(
+                name, value, request_id=rid, callback=cb
+            )
+
+        metrics = c.managers[0].metrics
+        resolver = TxnResolver(
+            submit, TXN_COORD, clock,
+            resolve_period_s=1.0, presume_abort_s=8.0,
+            retransmit_s=0.4, metrics=metrics, rng=rng,
+        )
+
+        zipf_w = [1.0 / (i + 1) ** zipf_alpha for i in range(n_accounts)]
+
+        def spawn() -> TxnDriver:
+            a = rng.choices(range(n_accounts), weights=zipf_w)[0]
+            b = a
+            while b == a:
+                b = rng.choices(range(n_accounts), weights=zipf_w)[0]
+            amt = rng.randint(1, amount_max)
+            txn = Transaction(
+                [(accounts[a], str(-amt)), (accounts[b], str(amt))],
+                txid=f"tx{rng.getrandbits(48):012x}",
+            )
+            return TxnDriver(
+                txn, submit, TXN_COORD, clock,
+                prepare_timeout_s=4.0, retransmit_s=0.4,
+                metrics=metrics, rng=rng,
+            )
+
+        active: List[TxnDriver] = []
+        outcomes: Dict[str, Optional[str]] = {}
+        ledger: Dict[str, List] = {}   # txid -> ops, COMMITTED only
+        killed: Dict[str, List] = {}
+        paused: Dict[str, Tuple] = {}  # name -> (member, resume_step)
+
+        def reap() -> None:
+            for d in list(active):
+                r = d.poll()
+                if r is not None:
+                    outcomes[r["txid"]] = r["outcome"]
+                    if r["outcome"] == COMMITTED:
+                        ledger[r["txid"]] = list(d.txn.ops)
+                    active.remove(d)
+
+        for _ in range(20):  # fault-free warmup: groups elect + settle
+            step()
+
+        for _ in range(rounds):
+            if len(active) < max_inflight and rng.random() < spawn_rate:
+                active.append(spawn())
+            reap()
+            if active and rng.random() < kill_rate:
+                d = active.pop(rng.randrange(len(active)))
+                killed[d.txn.txid] = list(d.txn.ops)
+            resolver.poll()
+            roll = rng.random()
+            if roll < restart_rate:
+                rid = rng.randrange(n_replicas)
+                # skip members holding a hibernated account: the wake
+                # path is exercised separately from crash replay
+                if all(mb != rid for mb, _ in paused.values()):
+                    c.restart(rid)
+                    c.managers[rid].tracer.enabled = True
+            elif roll < restart_rate + partition_rate:
+                part["cut"] = frozenset({rng.randrange(n_replicas)})
+                part["until"] = steps[0] + rng.randrange(10, 40)
+            elif roll < restart_rate + partition_rate + pause_rate:
+                nm = rng.choice(accounts)
+                mb = rng.randrange(n_replicas)
+                # hibernate on ONE member only — the group keeps quorum
+                # and the woken member heals as a straggler
+                if nm not in paused and c.managers[mb].hibernate(nm):
+                    paused[nm] = (mb, steps[0] + rng.randrange(20, 60))
+            for nm, (mb, due) in list(paused.items()):
+                if steps[0] >= due and c.managers[mb].restore(nm):
+                    del paused[nm]
+            step()
+
+        # ---- lossless settle until drivers + resolver drain -----------
+        chaos[0] = False
+        part["until"] = -1
+        for nm, (mb, _) in list(paused.items()):
+            if c.managers[mb].restore(nm):
+                del paused[nm]
+        if paused:
+            raise _divergence(
+                c, "hibernated account failed to wake",
+                {"paused": {n: p[0] for n, p in paused.items()}},
+                kind="txn-wake-failed",
+            )
+        deadline = time.time() + settle_budget_s
+        settled = False
+        drained_scan = None
+        while time.time() < deadline:
+            reap()
+            resolver.poll()
+            if not active and drained_scan is None:
+                drained_scan = resolver.scans
+            # idle must hold on a scan that STARTED after the last
+            # driver ended, hence the two-scan margin
+            if (not active and drained_scan is not None
+                    and resolver.scans >= drained_scan + 2
+                    and resolver.idle()):
+                settled = True
+                break
+            step()
+        if not settled:
+            raise _divergence(
+                c, "transactions did not settle",
+                {"active": [d.txn.txid for d in active],
+                 "live_records": resolver.live_records,
+                 "redriving": sorted(resolver._jobs)},
+                kind="txn-unsettled",
+            )
+
+        # ---- killed drivers: ONE global outcome per transaction -------
+        def coordinator_outcome(txid: str) -> Optional[str]:
+            box: List = []
+            rid = rng.randrange(1 << 48, 1 << 62)
+            val = txc_op("outcome", txid)
+            sent = -(10 ** 9)
+            for _ in range(1200):
+                if box:
+                    try:
+                        return json.loads(box[-1]).get("outcome")
+                    except (ValueError, TypeError):
+                        return None
+                if steps[0] - sent >= 8:
+                    sent = steps[0]
+                    submit(TXN_COORD, val, rid,
+                           lambda r, resp: box.append(resp))
+                step()
+            raise _divergence(c, "coordinator outcome query wedged",
+                              {"txid": txid}, kind="txn-outcome-wedge")
+
+        for txid, ops in killed.items():
+            if txid in outcomes:
+                continue
+            out = coordinator_outcome(txid)
+            # no record and no ended entry = the begin never decided:
+            # nothing was ever locked or staged, equivalent to abort
+            outcomes[txid] = out or ABORTED
+            if out == COMMITTED:
+                ledger[txid] = ops
+
+        # ---- audits ---------------------------------------------------
+        agree_deadline = time.time() + 120
+        while True:
+            views = {
+                nm: [m.app.totals.get(nm) for m in c.managers]
+                for nm in accounts
+            }
+            if all(len(set(v)) == 1 for v in views.values()):
+                break
+            if time.time() > agree_deadline:
+                raise _divergence(
+                    c, "txn RSM divergence: replicas disagree on balances",
+                    {"views": {nm: v for nm, v in views.items()
+                               if len(set(v)) > 1}},
+                    kind="txn-balance-divergence",
+                )
+            step()
+
+        for m in c.managers:
+            if m.app.locks or m.app.staged:
+                raise _divergence(
+                    c, "transaction locks/staged survive settle",
+                    {"member": m.my_id, "locks": dict(m.app.locks),
+                     "staged": sorted(m.app.staged)},
+                    kind="txn-lock-leak",
+                )
+
+        balances = {nm: views[nm][0] for nm in accounts}
+        expected = {nm: initial_balance for nm in accounts}
+        for ops in ledger.values():
+            for nm, dv in ops:
+                expected[nm] += int(dv)
+        total = sum(balances.values())
+        if total != initial_balance * n_accounts:
+            raise _divergence(
+                c, "conservation breach: money created or destroyed",
+                {"total": total, "want": initial_balance * n_accounts,
+                 "balances": balances},
+                kind="txn-conservation",
+            )
+        bad = {
+            nm: {"have": balances[nm], "want": expected[nm]}
+            for nm in accounts if balances[nm] != expected[nm]
+        }
+        if bad:
+            raise _divergence(
+                c,
+                "ledger mismatch: balances disagree with committed history",
+                {"names": bad}, kind="txn-ledger-mismatch",
+            )
+
+        n_comm = sum(1 for o in outcomes.values() if o == COMMITTED)
+        return {
+            "seed": seed, "steps": steps[0],
+            "txns": len(outcomes), "committed": n_comm,
+            "aborted": len(outcomes) - n_comm,
+            "killed": len(killed),
+            "in_doubt_resolved": resolver.resolved_count,
+        }
+    finally:
+        if c is not None:
+            c.close()
+        Config.clear()
+        if tmp:
+            shutil.rmtree(tmp, ignore_errors=True)

@@ -29,6 +29,10 @@ class ManagerCluster:
     ):
         R = cfg.n_replicas
         self.cfg = cfg
+        self._make_app = make_app
+        self._log_dirs = log_dirs
+        self._sync_journal = sync_journal
+        self._checkpoint_every = checkpoint_every
         self.managers: List[PaxosManager] = [
             PaxosManager(
                 rid,
@@ -75,6 +79,35 @@ class ManagerCluster:
             )
         self.blobs = [m.blob() for m in self.managers]
         return row
+
+    def restart(self, rid: int, hydrate: bool = True) -> PaxosManager:
+        """Crash-restart member ``rid``: close it and boot a FRESH
+        PaxosManager from the same ``log_dir`` — journal replay +
+        checkpoints are the only state that survives (queued vids,
+        outstanding callbacks, and anything unlogged die with the old
+        process, exactly as a real crash).  Requires ``log_dirs`` (a
+        restart without durability is just amnesia).  ``hydrate=True``
+        drains the lazy-hydration backlog synchronously so the member
+        serves immediately; pass False to exercise the hydration gates
+        themselves."""
+        if not self._log_dirs:
+            raise RuntimeError("restart needs log_dirs (durable members)")
+        self.managers[rid].close()
+        m = PaxosManager(
+            rid,
+            self._make_app(),
+            self.cfg,
+            log_dir=self._log_dirs[rid],
+            sync_journal=self._sync_journal,
+            checkpoint_every=self._checkpoint_every,
+        )
+        m.outstanding.timeout_s = float("inf")
+        self.managers[rid] = m
+        if hydrate:
+            m.hydrate_all()
+        self.blobs[rid] = m.blob()
+        self.inboxes[rid] = []
+        return m
 
     # ---- client entry ---------------------------------------------------
     def submit(self, name: str, value: str, entry: int = 0,

@@ -1,200 +1,105 @@
-"""Distributed transactions across RSM groups (experimental, matching the
-reference's scope).
+"""Synchronous transaction front-end over the poll-driven
+:class:`~gigapaxos_tpu.txn.driver.TxnDriver`.
 
-API-parity target: ``txn/DistTransactor.java`` (333 LoC wrapping an
-``AbstractReplicaCoordinator``) with the 2PC-style ops of
-``txn/txpackets/`` (LockRequest / UnlockRequest / TxOpRequest /
-CommitRequest / AbortRequest) — present and functional but explicitly
-*experimental*, exactly as in the reference (``SURVEY.md`` §2.6: "treat
-as capability stub: present, compiles, not load-bearing").
+:class:`Transaction` names the ops; :class:`Transactor` runs one
+transaction to its single global outcome by alternating driver polls
+with cluster steps.  Time inside :meth:`Transactor.run` is LOGICAL —
+each ``step()`` advances an internal clock by ``step_dt`` — so lock
+waits, retransmits, and the prepare timeout all follow the
+chaos-compressed clock convention (no ``time.time()`` gate anywhere in
+the protocol path; ROADMAP item 1's no-hard-wall-clock-gates rule).
+A caller with real time to spend can inject its own ``clock``.
 
-Design: locks are themselves CONSENSUS operations.  :class:`TxnApp`
-wraps the user's Replicable; reserved ``__tx__``-prefixed request values
-are interpreted as lock-table ops (acquire/release/apply), everything
-else passes through — but is refused while the group is locked by a
-transaction, making each group's lock linearizable with its log.  The
-transactor acquires locks in sorted-name order (deadlock freedom),
-applies the ops, then releases — each step an ordinary replicated
-request, so crash recovery replays to a consistent lock state and an
-abort path releases whatever was acquired.
-
-Guarantee honesty (same envelope as the reference's experimental txn):
-this provides ISOLATION (no other request or transaction interleaves
-with a locked group) and lock-phase all-or-nothing, but an abort during
-the APPLY phase does not roll back ops already applied to earlier
-groups — there is no undo log.  An aborted result reports how many ops
-had applied (``applied_ops``) so callers can compensate.
+``DistTransactor`` remains as the reference-named alias
+(``txn/DistTransactor.java``), now implemented — not a capability
+stub: aborts discard STAGED ops, so no participant is ever mutated by
+a transaction that did not commit.
 """
 
 from __future__ import annotations
 
-import json
 import random
-import time
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
-from ..interfaces.app import Replicable, Request
-
-TX_PREFIX = "__tx__:"
-
-
-class TxnApp(Replicable):
-    """Replicable wrapper adding a per-name transaction lock table
-    (``TXLockerMap`` analog); the lock state is part of the RSM (it rides
-    checkpoints), so all replicas agree on it."""
-
-    def __init__(self, app: Replicable):
-        self.app = app
-        self.locks: Dict[str, str] = {}  # name -> holding txid
-
-    # ---- Replicable ----------------------------------------------------
-    def execute(self, request: Request, do_not_reply_to_client: bool = False) -> bool:
-        name = request.paxos_id
-        value = request.request_value or ""
-        if value.startswith(TX_PREFIX):
-            op = json.loads(value[len(TX_PREFIX):])
-            request.response_value = json.dumps(self._tx_op(name, op))
-            return True
-        holder = self.locks.get(name)
-        if holder is not None:
-            # group locked by an in-flight transaction: refuse (the client
-            # retries; LockRequest semantics)
-            request.response_value = json.dumps(
-                {"ok": False, "locked_by": holder}
-            )
-            return True
-        return self.app.execute(request, do_not_reply_to_client)
-
-    def _tx_op(self, name: str, op: Dict) -> Dict:
-        kind, txid = op["kind"], op["txid"]
-        holder = self.locks.get(name)
-        if kind == "lock":
-            if holder is None:
-                self.locks[name] = txid
-                return {"ok": True}
-            return {"ok": holder == txid, "locked_by": holder}
-        if kind == "unlock":
-            if holder == txid:
-                del self.locks[name]
-            return {"ok": True}  # idempotent
-        if kind == "apply":
-            if holder != txid:
-                return {"ok": False, "locked_by": holder}
-            from ..packets.paxos_packets import RequestPacket
-
-            inner = RequestPacket(
-                paxos_id=name, request_id=int(op["rid"]),
-                request_value=op["value"],
-            )
-            self.app.execute(inner, True)
-            return {"ok": True,
-                    "response": getattr(inner, "response_value", None)}
-        return {"ok": False, "error": f"unknown tx op {kind!r}"}
-
-    def checkpoint(self, name: str) -> Optional[str]:
-        return json.dumps({
-            "app": self.app.checkpoint(name),
-            "lock": self.locks.get(name),
-        })
-
-    def restore(self, name: str, state: Optional[str]) -> bool:
-        if state:
-            try:
-                d = json.loads(state)
-            except (json.JSONDecodeError, TypeError):
-                d = {"app": state, "lock": None}
-            if isinstance(d, dict) and "app" in d:
-                if d.get("lock") is not None:
-                    self.locks[name] = d["lock"]
-                else:
-                    self.locks.pop(name, None)
-                return self.app.restore(name, d["app"])
-        else:
-            self.locks.pop(name, None)
-        return self.app.restore(name, state)
-
-    def get_request(self, stringified: str):
-        return self.app.get_request(stringified)
-
-    # convenience passthroughs for fixtures
-    def __getattr__(self, item):
-        return getattr(self.app, item)
+from .app import TXN_COORD
+from .driver import TxnDriver
 
 
 class Transaction:
-    """An ordered set of (name, request_value) ops applied atomically
-    w.r.t. other transactions and single-group requests."""
+    """An ordered set of (name, request_value) ops applied atomically:
+    either every op executes (exactly once) or none does."""
 
-    def __init__(self, ops: List[Tuple[str, str]]):
+    def __init__(self, ops: List[Tuple[str, str]],
+                 txid: Optional[str] = None,
+                 rng: Optional[random.Random] = None):
         self.ops = list(ops)
-        self.txid = f"tx{random.randrange(1 << 48):012x}"
+        r = rng or random
+        self.txid = txid or f"tx{r.randrange(1 << 48):012x}"
 
     @property
     def names(self) -> List[str]:
         return sorted({n for n, _ in self.ops})
 
 
-class DistTransactor:
-    """Drives transactions through any request submitter
-    (``DistTransactor.java`` analog).  ``submit(name, value, timeout)``
-    must deliver a consensus-executed response string or None."""
+class Transactor:
+    """Run transactions synchronously against a stepped cluster.
 
-    def __init__(self, submit, lock_timeout_s: float = 10.0):
+    ``submit(name, value, request_id, callback)`` proposes one
+    replicated request (async); ``step()`` advances the cluster one
+    tick.  Each step advances the logical clock by ``step_dt`` seconds.
+    """
+
+    def __init__(
+        self,
+        submit: Callable[[str, str, int, Callable], None],
+        step: Callable[[], None],
+        coord: str = TXN_COORD,
+        *,
+        step_dt: float = 0.05,
+        prepare_timeout_s: Optional[float] = None,
+        retransmit_s: float = 0.25,
+        metrics=None,
+        rng: Optional[random.Random] = None,
+    ):
         self.submit = submit
-        self.lock_timeout_s = lock_timeout_s
+        self.step = step
+        self.coord = coord
+        self.step_dt = float(step_dt)
+        self.prepare_timeout_s = prepare_timeout_s
+        self.retransmit_s = retransmit_s
+        self.metrics = metrics
+        self.rng = rng
+        self._steps = 0
 
-    def _tx(self, name: str, op: Dict, timeout: float) -> Optional[Dict]:
-        resp = self.submit(
-            name, TX_PREFIX + json.dumps(op, separators=(",", ":")), timeout
+    def clock(self) -> float:
+        """Logical seconds: steps taken x step_dt (chaos-compressed)."""
+        return self._steps * self.step_dt
+
+    def driver(self, txn: Transaction) -> TxnDriver:
+        return TxnDriver(
+            txn, self.submit, self.coord, self.clock,
+            prepare_timeout_s=self.prepare_timeout_s,
+            retransmit_s=self.retransmit_s,
+            metrics=self.metrics, rng=self.rng,
         )
-        if resp is None:
-            return None
-        return json.loads(resp)
 
-    def execute(self, txn: Transaction, timeout: float = 30.0) -> Dict:
-        """Lock all groups (sorted order — deadlock-free), apply all ops,
-        unlock.  On failure: release acquired locks and report abort with
-        `applied_ops` (ops already applied are NOT rolled back — see the
-        module docstring's guarantee note)."""
-        deadline = time.time() + timeout
-        acquired: List[str] = []
-        applied = 0
-        try:
-            for name in txn.names:  # phase 1: lock
-                while True:
-                    r = self._tx(name, {"kind": "lock", "txid": txn.txid},
-                                 self.lock_timeout_s)
-                    if r and r.get("ok"):
-                        acquired.append(name)
-                        break
-                    if time.time() > deadline:
-                        return self._abort(txn, acquired, "lock-timeout", 0)
-                    time.sleep(0.05)  # holder backoff (TXLockerMap wait)
-            results = []
-            for i, (name, value) in enumerate(txn.ops):  # phase 2: apply
-                r = self._tx(name, {
-                    "kind": "apply", "txid": txn.txid,
-                    "rid": random.randrange(1 << 53, 1 << 62),
-                    "value": value,
-                }, max(1.0, deadline - time.time()))
-                if not (r and r.get("ok")):
-                    return self._abort(
-                        txn, acquired, f"apply-failed@{i}", applied
-                    )
-                applied += 1
-                results.append(r.get("response"))
-            self._release(txn, acquired)
-            return {"committed": True, "responses": results}
-        except Exception as e:  # release on any client-side failure
-            self._abort(txn, acquired, repr(e), applied)
-            raise
+    def run(self, txn: Transaction, max_steps: int = 20000) -> Dict:
+        """Drive ``txn`` to its decided outcome; returns the driver's
+        result dict (``committed``/``outcome``/``responses``).  Raises
+        ``TimeoutError`` only if the cluster makes no progress within
+        ``max_steps`` ticks — a liveness budget, not a wall clock."""
+        d = self.driver(txn)
+        for _ in range(max_steps):
+            out = d.poll()
+            if out is not None:
+                return out
+            self.step()
+            self._steps += 1
+        raise TimeoutError(
+            f"transaction {txn.txid} undecided after {max_steps} steps "
+            f"(state={d._state})"
+        )
 
-    def _release(self, txn: Transaction, names: List[str]) -> None:
-        for name in names:
-            self._tx(name, {"kind": "unlock", "txid": txn.txid},
-                     self.lock_timeout_s)
 
-    def _abort(self, txn: Transaction, acquired: List[str], why: str,
-               applied: int) -> Dict:
-        self._release(txn, acquired)
-        return {"committed": False, "aborted": why, "applied_ops": applied}
+#: reference-named alias (``txn/DistTransactor.java``)
+DistTransactor = Transactor

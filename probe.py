@@ -26,7 +26,24 @@ from gigapaxos_tpu.testing.ports import free_ports
 
 
 def main() -> int:
+    if "--bank-ledger" in sys.argv[1:]:
+        # delegate to the bank-ledger transaction workload, passing every
+        # OTHER argument through (its own argparse owns the flag set)
+        import runpy
+
+        sys.argv = [
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "scenarios", "bank_ledger.py"),
+        ] + [a for a in sys.argv[1:] if a != "--bank-ledger"]
+        runpy.run_path(sys.argv[0], run_name="__main__")
+        return 0  # bank_ledger sys.exit()s itself; not reached
+
     ap = argparse.ArgumentParser()
+    ap.add_argument("--bank-ledger", action="store_true",
+                    help="run the Zipfian bank-ledger 2PC transaction "
+                         "workload (scenarios/bank_ledger.py) instead of "
+                         "the capacity ramp; remaining args are ITS flags "
+                         "(--accounts, --txns, --inflight, --out, ...)")
     ap.add_argument("--init-load", type=float, default=500.0,
                     help="starting request rate/s (PROBE_INIT_LOAD analog)")
     ap.add_argument("--factor", type=float, default=1.1)

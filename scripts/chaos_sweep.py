@@ -29,7 +29,15 @@ jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, ".")
 
-from gigapaxos_tpu.testing.chaos import SoakDivergence, run_soak  # noqa: E402
+from gigapaxos_tpu.testing.chaos import (  # noqa: E402
+    SoakDivergence,
+    run_soak,
+    run_txn_soak,
+)
+
+#: stats keys worth carrying into the artifact, per soak flavor
+_STAT_KEYS = ("settle_iters", "txns", "committed", "aborted", "killed",
+              "in_doubt_resolved")
 
 
 def main() -> None:
@@ -43,9 +51,27 @@ def main() -> None:
     ap.add_argument("--names", type=int, default=6)
     ap.add_argument("--loss", type=float, default=0.2)
     ap.add_argument("--dup-rate", type=float, default=0.0)
+    ap.add_argument("--family", default="core",
+                    help="comma list of soak families to run per seed: "
+                         "core (reconfiguration-plane run_soak) and/or "
+                         "txn (2PC bank-transfer run_txn_soak, its own "
+                         "tuned fault rates)")
     ap.add_argument("--out", default="CHAOS_SWEEP_r01.json",
                     help="sweep artifact path ('' disables the write)")
     args = ap.parse_args()
+
+    runners = {
+        "core": lambda seed: run_soak(
+            seed, rounds=args.rounds, n_names=args.names,
+            loss=args.loss, dup_rate=args.dup_rate,
+        ),
+        "txn": run_txn_soak,
+    }
+    families = [f.strip() for f in args.family.split(",") if f.strip()]
+    unknown = [f for f in families if f not in runners]
+    if unknown:
+        ap.error(f"unknown --family {unknown} (choose from "
+                 f"{sorted(runners)})")
 
     fails = []
     results = []
@@ -53,35 +79,38 @@ def main() -> None:
     done = 0
     for i in range(args.count):
         seed = args.base + i * args.stride
-        t = time.time()
-        try:
-            stats = run_soak(seed, rounds=args.rounds, n_names=args.names,
-                             loss=args.loss, dup_rate=args.dup_rate)
-            results.append({
-                "seed": seed, "ok": True,
-                "elapsed_s": round(time.time() - t, 1),
-                "settle_iters": stats.get("settle_iters"),
-            })
-            print(f"[{i}] seed={seed} OK {time.time() - t:.1f}s", flush=True)
-        except Exception as e:
-            print(f"[{i}] seed={seed} FAIL {time.time() - t:.1f}s: {e}",
-                  flush=True)
-            traceback.print_exc()
-            fails.append(seed)
-            ent = {
-                "seed": seed, "ok": False,
-                "elapsed_s": round(time.time() - t, 1),
-                "error_type": type(e).__name__,
-                # the first line carries the invariant that broke; the
-                # full diag is in the flight dumps + stdout log
-                "error": str(e)[:2000],
-            }
-            if isinstance(e, SoakDivergence):
-                ent["flight_dumps"] = e.diag.get("flight_dumps", [])
-                ent["divergent_names"] = sorted(
-                    str(v) for k, v in e.diag.items() if k == "name"
-                )
-            results.append(ent)
+        for family in families:
+            t = time.time()
+            try:
+                stats = runners[family](seed)
+                ent = {
+                    "family": family, "seed": seed, "ok": True,
+                    "elapsed_s": round(time.time() - t, 1),
+                }
+                ent.update({k: stats[k] for k in _STAT_KEYS
+                            if k in stats})
+                results.append(ent)
+                print(f"[{i}] {family} seed={seed} OK "
+                      f"{time.time() - t:.1f}s", flush=True)
+            except Exception as e:
+                print(f"[{i}] {family} seed={seed} FAIL "
+                      f"{time.time() - t:.1f}s: {e}", flush=True)
+                traceback.print_exc()
+                fails.append({"family": family, "seed": seed})
+                ent = {
+                    "family": family, "seed": seed, "ok": False,
+                    "elapsed_s": round(time.time() - t, 1),
+                    "error_type": type(e).__name__,
+                    # the first line carries the invariant that broke; the
+                    # full diag is in the flight dumps + stdout log
+                    "error": str(e)[:2000],
+                }
+                if isinstance(e, SoakDivergence):
+                    ent["flight_dumps"] = e.diag.get("flight_dumps", [])
+                    ent["divergent_names"] = sorted(
+                        str(v) for k, v in e.diag.items() if k == "name"
+                    )
+                results.append(ent)
         done += 1
         if args.budget_s is not None and time.time() - t0 > args.budget_s:
             break
@@ -95,10 +124,12 @@ def main() -> None:
                 "stride": args.stride, "rounds": args.rounds,
                 "names": args.names, "loss": args.loss,
                 "dup_rate": args.dup_rate,
+                "families": families,
             },
             "ran": done,
             "failed_seeds": fails,
-            "fail_rate": round(len(fails) / done, 4) if done else None,
+            "fail_rate": round(len(fails) / (done * len(families)), 4)
+            if done else None,
             "elapsed_s": round(time.time() - t0, 1),
             "seeds": results,
         }

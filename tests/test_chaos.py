@@ -26,11 +26,11 @@ import warnings
 import pytest
 
 
-def _fresh(seed: int, repro: str, **kw) -> bool:
+def _fresh(seed: int, repro: str, fn=None, **kw) -> bool:
     """Run one discovery soak; returns False when the budgeted loop
     should stop early (strict mode raises instead)."""
     try:
-        run_soak(seed, **kw)
+        (fn or run_soak)(seed, **kw)
         return True
     except Exception as e:
         msg = (
@@ -43,7 +43,7 @@ def _fresh(seed: int, repro: str, **kw) -> bool:
         return False
 
 from gigapaxos_tpu.ops.engine import EngineConfig
-from gigapaxos_tpu.testing.chaos import run_soak
+from gigapaxos_tpu.testing.chaos import run_soak, run_txn_soak
 
 _SEEDS = (
     [int(os.environ["CHAOS_SEED"])] if os.environ.get("CHAOS_SEED")
@@ -64,10 +64,64 @@ _SEEDS = (
 # test_unpaired_dedup_install_regression as the schedule-independent one
 _BREACH_SEEDS = [991134624, 881578088, 881205895, 662625602]
 
+# txn-family breach shapes from the r1 fresh-seed txn sweeps — all
+# three are forced-pause (hibernate) wounds.  786083501 / 786384423:
+# the pause snapshotted a non-quiescent row (app cursor behind the
+# device frontier) and the restore reinstated the stranded cursor with
+# the gap's decisions gone from every store — no heal detector fired
+# because the gap sat under jump_horizon with nothing payload-blocked
+# (fixed: resume parks such rows in _needs_state so the state pull +
+# app_only adoption close the gap).  495514: a proposal admitted into
+# the device ring before the pause was in neither the held queue nor
+# the window remnants, so its surviving inflight entry parked every
+# retransmit of that request id and poisoned forward-dedup of fresh
+# peer proposals — the resolver's commit re-drive starved through 4k+
+# retransmits (fixed: resume releases orphaned undecided vids).  Pinned
+# so the hibernate-mid-traffic schedules stay covered
+_TXN_BREACH_SEEDS = [786083501, 786384423, 495514]
+
+# txn green pins: deterministic full-default schedules (kills,
+# restarts, partitions, hibernates, in-doubt resolution) that must stay
+# green
+_TXN_SEEDS = (
+    [int(os.environ["CHAOS_TXN_SEED"])]
+    if os.environ.get("CHAOS_TXN_SEED") else [11, 1, 2]
+)
+
 
 @pytest.mark.parametrize("seed", _BREACH_SEEDS)
 def test_chaos_breach_shapes(seed):
     run_soak(seed, rounds=90, loss=0.3)
+
+
+@pytest.mark.parametrize("seed", _TXN_BREACH_SEEDS)
+def test_txn_breach_shapes(seed):
+    run_txn_soak(seed)
+
+
+@pytest.mark.parametrize("seed", _TXN_SEEDS)
+@pytest.mark.slow
+def test_txn_soak_pinned(seed):
+    run_txn_soak(seed)
+
+
+def test_txn_fresh_seeds():
+    """Budgeted fresh-seed discovery over the txn 2PC soak family —
+    same DISCOVERY/strict convention as test_chaos_fresh_seeds."""
+    budget = float(os.environ.get("CHAOS_TXN_BUDGET_S", "60"))
+    base = (int(time.time()) + 104729) % 1_000_000_007
+    deadline = time.time() + budget
+    ran = 0
+    while ran == 0 or time.time() < deadline:
+        seed = base + ran * 7919
+        if not _fresh(
+            seed,
+            f"CHAOS_TXN_SEED={seed} pytest "
+            f"tests/test_chaos.py::test_txn_soak_pinned",
+            fn=run_txn_soak,
+        ):
+            break
+        ran += 1
 
 
 def test_unpaired_dedup_install_regression():

@@ -1,51 +1,99 @@
-"""Distributed transactions (experimental capability parity: ``txn/
-DistTransactor.java`` + ``txn/txpackets/``): sorted-order 2PC locks as
-consensus ops, atomic multi-group apply, abort releases locks, and
-ordinary requests are refused while a group is locked."""
+"""Distributed transactions (``txn/``: sorted 2PC-over-Paxos, the
+``DistTransactor.java`` capability made real): every 2PC transition is a
+replicated request, commits apply staged ops atomically, aborts discard
+them (staged-until-decision — NO participant is ever mutated by a
+transaction that did not commit), late prepares hit the resolved-ring
+fence, retryable refusals stay out of the exactly-once response cache,
+and crash recovery re-derives the whole transaction plane from the
+journal (commit re-drive AND presumed abort)."""
+
+import json
 
 from gigapaxos_tpu.models.apps import StatefulAdderApp
 from gigapaxos_tpu.ops.engine import EngineConfig
 from gigapaxos_tpu.testing.cluster import ManagerCluster
-from gigapaxos_tpu.txn import DistTransactor, Transaction, TxnApp
+from gigapaxos_tpu.txn import (
+    ABORTED,
+    COMMITTED,
+    TXN_COORD,
+    DistTransactor,
+    Transaction,
+    Transactor,
+    TxnApp,
+    TxnResolver,
+    tx_op,
+    txc_op,
+)
 
 CFG = EngineConfig(n_groups=8, window=8, req_lanes=4, n_replicas=3)
 
 
-def make_cluster():
-    c = ManagerCluster(CFG, lambda: TxnApp(StatefulAdderApp()))
+def make_cluster(**kw):
+    c = ManagerCluster(CFG, lambda: TxnApp(StatefulAdderApp()), **kw)
+    c.create(TXN_COORD)
     c.create("acct_a")
     c.create("acct_b")
     return c
 
 
-def submitter(c):
-    """Synchronous consensus submit driving the loopback cluster."""
+_RID = [1 << 40]  # process-wide: two sync_send instances must not collide
 
-    def submit(name, value, timeout):
-        box = {}
-        c.managers[0].propose(
-            name, value, callback=lambda rid, resp: box.update(r=resp)
-        )
-        for _ in range(int(timeout / 0.001) if timeout < 5 else 400):
-            if "r" in box:
-                return box["r"]
+
+def sync_send(c, entry=0):
+    """Synchronous replicated submit: one request id per call (minted up
+    front so retransmits dedup), retransmitted on a step cadence until
+    the decided response arrives."""
+
+    def send(name, value, rid=None, max_steps=600):
+        _RID[0] += 1
+        rid_ = _RID[0] if rid is None else rid
+        box = []
+        for attempt in range(max_steps):
+            if attempt % 40 == 0:
+                c.managers[entry].propose(
+                    name, value, request_id=rid_,
+                    callback=lambda r, resp: box.append(resp),
+                )
+            if box:
+                return json.loads(box[-1])
             c.step_all()
-        return box.get("r")
+        raise AssertionError(f"no decision for {name}:{value[:40]}")
+
+    return send
+
+
+def async_submit(c, entry=0):
+    def submit(name, value, rid, cb):
+        c.managers[entry].propose(name, value, request_id=rid, callback=cb)
 
     return submit
+
+
+def transactor(c, **kw):
+    return Transactor(async_submit(c), lambda: c.step_all(), **kw)
+
+
+# ---------------------------------------------------------------------------
+# the happy path + the reference-named alias
+# ---------------------------------------------------------------------------
 
 
 def test_transaction_commits_across_groups():
     c = make_cluster()
     try:
-        tx = DistTransactor(submitter(c))
-        out = tx.execute(Transaction([("acct_a", "5"), ("acct_b", "7")]))
-        assert out["committed"], out
+        assert DistTransactor is Transactor  # the stub name, now real
+        out = transactor(c).run(
+            Transaction([("acct_a", "5"), ("acct_b", "7")])
+        )
+        assert out["committed"] and out["outcome"] == COMMITTED, out
         c.run(6)
         for m in c.managers:
             assert m.app.totals.get("acct_a") == 5
             assert m.app.totals.get("acct_b") == 7
-            assert m.app.locks == {}  # all released
+            assert m.app.locks == {} and m.app.staged == {}
+        # the coordinator record was ended; the outcome still answers
+        r = sync_send(c)(TXN_COORD, txc_op("outcome", out["txid"]))
+        assert r["outcome"] == COMMITTED
     finally:
         c.close()
 
@@ -53,47 +101,275 @@ def test_transaction_commits_across_groups():
 def test_locked_group_refuses_plain_requests_until_release():
     c = make_cluster()
     try:
-        submit = submitter(c)
-        tx = DistTransactor(submit)
-        txn = Transaction([("acct_a", "1")])
-        # acquire the lock manually (phase 1 only)
-        r = tx._tx("acct_a", {"kind": "lock", "txid": txn.txid}, 5)
-        assert r and r["ok"]
-        # a plain request against the locked group is refused
-        import json
-
-        resp = submit("acct_a", "99", 5)
-        assert resp is not None and not json.loads(resp).get("ok")
-        assert json.loads(resp)["locked_by"] == txn.txid
+        send = sync_send(c)
+        txid = "txlockhold"
+        r = send("acct_a", tx_op("prepare", txid, vals=["1"]))
+        assert r["ok"], r
+        # a plain request against the locked group is refused retryably
+        resp = send("acct_a", "99")
+        assert not resp["ok"] and resp["locked_by"] == txid and resp["retry"]
         for m in c.managers:
             assert m.app.totals.get("acct_a", 0) == 0
-        # release; plain requests flow again
-        tx._tx("acct_a", {"kind": "unlock", "txid": txn.txid}, 5)
-        resp = submit("acct_a", "3", 5)
-        assert resp is not None
+        # abort releases the lock; plain requests flow again
+        assert send("acct_a", tx_op("abort", txid))["ok"]
+        assert send("acct_a", "3")  # decided
         c.run(4)
         assert c.managers[0].app.totals.get("acct_a") == 3
     finally:
         c.close()
 
 
-def test_abort_releases_acquired_locks():
+# ---------------------------------------------------------------------------
+# staged-until-decision: abort leaves NO participant mutated
+# ---------------------------------------------------------------------------
+
+
+def test_abort_mid_protocol_leaves_participants_unmutated():
+    """The old stub's no-undo hole, closed: prepare STAGES ops without
+    applying them, so an abort after a partial prepare round leaves every
+    participant byte-identical — on every replica."""
     c = make_cluster()
     try:
-        submit = submitter(c)
-        tx = DistTransactor(submit, lock_timeout_s=2)
-        # a rival transaction holds acct_b, so ours cannot lock it
-        rival = Transaction([("acct_b", "0")])
-        assert tx._tx("acct_b", {"kind": "lock", "txid": rival.txid}, 5)["ok"]
-        out = tx.execute(
-            Transaction([("acct_a", "2"), ("acct_b", "4")]), timeout=3
-        )
-        assert not out["committed"] and "lock" in out["aborted"]
+        send = sync_send(c)
+        txid = "txabortarm"
+        r = send(TXN_COORD, txc_op(
+            "begin", txid, names=["acct_a", "acct_b"],
+            ops=[["acct_a", "5"], ["acct_b", "7"]], t=0.0,
+        ))
+        assert r["ok"]
+        assert send("acct_a", tx_op("prepare", txid, vals=["5"]))["ok"]
         c.run(4)
-        # acct_a's lock (acquired first) was released by the abort
+        for m in c.managers:  # staged + locked, NOT applied
+            assert m.app.locks.get("acct_a") == txid
+            assert m.app.staged["acct_a"][0] == txid
+            assert m.app.totals.get("acct_a", 0) == 0
+        # global abort: decide, drive to BOTH names, end
+        assert send(TXN_COORD, txc_op(
+            "decide", txid, outcome=ABORTED))["outcome"] == ABORTED
+        assert send("acct_a", tx_op("abort", txid))["ok"]
+        assert send("acct_b", tx_op("abort", txid))["ok"]
+        assert send(TXN_COORD, txc_op("end", txid))["outcome"] == ABORTED
+        c.run(4)
         for m in c.managers:
+            assert m.app.totals.get("acct_a", 0) == 0
+            assert m.app.totals.get("acct_b", 0) == 0
+            assert m.app.locks == {} and m.app.staged == {}
+        # the late-prepare fence: a straggling prepare retransmit decided
+        # AFTER the abort must refuse, not re-lock
+        r = send("acct_b", tx_op("prepare", txid, vals=["7"]))
+        assert not r["ok"] and r["resolved"] == ABORTED
+        for m in c.managers:
+            assert m.app.locks == {}
+    finally:
+        c.close()
+
+
+def test_prepare_timeout_aborts_and_releases_sorted_prefix():
+    c = make_cluster()
+    try:
+        send = sync_send(c)
+        # a rival holds acct_b (second in sorted lock order)
+        rival = "txrival"
+        assert send("acct_b", tx_op("prepare", rival, vals=["0"]))["ok"]
+        out = transactor(c, prepare_timeout_s=1.0).run(
+            Transaction([("acct_a", "2"), ("acct_b", "4")])
+        )
+        assert not out["committed"] and "timeout" in out["aborted"], out
+        c.run(4)
+        for m in c.managers:
+            # acct_a's lock (the acquired prefix) was released; nothing
+            # was applied anywhere
             assert "acct_a" not in m.app.locks
             assert m.app.totals.get("acct_a", 0) == 0
             assert m.app.totals.get("acct_b", 0) == 0
+            # the rival still holds its lock — only OUR prefix rolled back
+            assert m.app.locks.get("acct_b") == rival
     finally:
         c.close()
+
+
+def test_lock_wait_retries_until_rival_releases():
+    """Same-rid retransmit IS the lock-wait retry: the refusal is left
+    uncached, so the identical request id re-executes after release."""
+    c = make_cluster()
+    try:
+        send = sync_send(c)
+        rival = "txslow"
+        assert send("acct_a", tx_op("prepare", rival, vals=["0"]))["ok"]
+        steps = [0]
+        from gigapaxos_tpu.txn import TxnDriver
+
+        d = TxnDriver(
+            Transaction([("acct_a", "3")]), async_submit(c), TXN_COORD,
+            lambda: steps[0] * 0.05, prepare_timeout_s=60.0,
+        )
+
+        def pump(n):
+            for _ in range(n):
+                if d.poll() is not None:
+                    return
+                c.step_all()
+                steps[0] += 1
+
+        pump(60)
+        assert d.poll() is None  # still waiting on the rival's lock
+        assert send("acct_a", tx_op("abort", rival))["ok"]
+        pump(800)
+        out = d.poll()
+        assert out is not None and out["committed"], out
+        c.run(4)
+        for m in c.managers:
+            assert m.app.totals.get("acct_a") == 3
+            assert m.app.locks == {}
+    finally:
+        c.close()
+
+
+def test_retryable_refusal_is_not_cached():
+    """A refusal sets ``request.txn_retry`` and stays OUT of the response
+    cache, so the SAME request id executes after the lock clears — and
+    exactly once (the post-execute retransmit answers from cache)."""
+    c = make_cluster()
+    try:
+        send = sync_send(c)
+        rival = "txholder"
+        assert send("acct_a", tx_op("prepare", rival, vals=["0"]))["ok"]
+        rid = 0x5EED5EED
+        r = send("acct_a", "9", rid=rid)
+        assert not r["ok"] and r["retry"]
+        assert send("acct_a", tx_op("abort", rival))["ok"]
+        # same rid again: executes now (a cached refusal would bounce it)
+        r = send("acct_a", "9", rid=rid)
+        assert r == 9, r  # the adder's response is the new total
+        c.run(4)
+        assert c.managers[0].app.totals.get("acct_a") == 9
+        # and a THIRD retransmit dedups — no double apply
+        r = send("acct_a", "9", rid=rid)
+        c.run(4)
+        for m in c.managers:
+            assert m.app.totals.get("acct_a") == 9
+    finally:
+        c.close()
+
+
+# ---------------------------------------------------------------------------
+# crash recovery: the whole transaction plane replays from the journal
+# ---------------------------------------------------------------------------
+
+
+def _resolver_for(c, presume_abort_s=5.0):
+    steps = [0]
+
+    def clock():
+        return steps[0] * 0.05
+
+    res = TxnResolver(
+        async_submit(c), TXN_COORD, clock,
+        resolve_period_s=0.2, presume_abort_s=presume_abort_s,
+        retransmit_s=0.2,
+    )
+
+    def pump(max_steps=4000):
+        for _ in range(max_steps):
+            res.poll()
+            c.step_all()
+            steps[0] += 1
+            if res.scans >= 3 and res.idle():
+                return
+        raise AssertionError(
+            f"resolver never drained: live={res.live_records} "
+            f"jobs={sorted(res._jobs)}"
+        )
+
+    return res, pump
+
+
+def test_coordinator_crash_commit_arm_recovers_from_journal(tmp_path):
+    """Driver dies between decide(committed) and the outcome drive; every
+    member crash-restarts; journal replay rebuilds locks + the decided
+    record and the resolver re-drives the commit to a single global
+    outcome."""
+    dirs = [str(tmp_path / f"n{r}") for r in range(3)]
+    c = make_cluster(log_dirs=dirs, checkpoint_every=4)
+    try:
+        send = sync_send(c)
+        txid = "txcommitarm"
+        assert send(TXN_COORD, txc_op(
+            "begin", txid, names=["acct_a", "acct_b"],
+            ops=[["acct_a", "5"], ["acct_b", "7"]], t=0.0,
+        ))["ok"]
+        assert send("acct_a", tx_op("prepare", txid, vals=["5"]))["ok"]
+        assert send("acct_b", tx_op("prepare", txid, vals=["7"]))["ok"]
+        assert send(TXN_COORD, txc_op("prepared", txid))["ok"]
+        assert send(TXN_COORD, txc_op(
+            "decide", txid, outcome=COMMITTED))["outcome"] == COMMITTED
+        c.run(4)
+        # ---- the driver dies HERE; the whole cluster crash-restarts ----
+        for rid in range(3):
+            c.restart(rid)
+        for m in c.managers:  # replay rebuilt the transaction plane
+            assert m.app.locks.get("acct_a") == txid
+            assert m.app.locks.get("acct_b") == txid
+            assert m.app.records[TXN_COORD][txid]["state"] == COMMITTED
+            assert m.app.totals.get("acct_a", 0) == 0  # NOT yet applied
+        res, pump = _resolver_for(c)
+        pump()
+        assert res.resolved_count == 1
+        for m in c.managers:
+            assert m.app.totals.get("acct_a") == 5
+            assert m.app.totals.get("acct_b") == 7
+            assert m.app.locks == {} and m.app.staged == {}
+        assert sync_send(c)(
+            TXN_COORD, txc_op("outcome", txid))["outcome"] == COMMITTED
+    finally:
+        c.close()
+
+
+def test_coordinator_crash_presumed_abort_arm(tmp_path):
+    """Driver dies mid-prepare (one lock taken, nothing decided); after
+    restart the resolver presumes abort past the horizon, releases the
+    lock, fences the in-flight prepare, and no participant is mutated."""
+    dirs = [str(tmp_path / f"n{r}") for r in range(3)]
+    c = make_cluster(log_dirs=dirs, checkpoint_every=4)
+    try:
+        send = sync_send(c)
+        txid = "txdoubtarm"
+        assert send(TXN_COORD, txc_op(
+            "begin", txid, names=["acct_a", "acct_b"],
+            ops=[["acct_a", "5"], ["acct_b", "7"]], t=0.0,
+        ))["ok"]
+        assert send("acct_a", tx_op("prepare", txid, vals=["5"]))["ok"]
+        c.run(4)
+        # ---- driver dies; cluster crash-restarts -----------------------
+        for rid in range(3):
+            c.restart(rid)
+        for m in c.managers:
+            assert m.app.locks.get("acct_a") == txid
+            assert m.app.records[TXN_COORD][txid]["state"] == "begun"
+        res, pump = _resolver_for(c, presume_abort_s=0.5)
+        pump()
+        assert res.resolved_count == 1
+        for m in c.managers:
+            assert m.app.totals.get("acct_a", 0) == 0
+            assert m.app.totals.get("acct_b", 0) == 0
+            assert m.app.locks == {} and m.app.staged == {}
+        send = sync_send(c)
+        assert send(TXN_COORD,
+                    txc_op("outcome", txid))["outcome"] == ABORTED
+        # the fence holds for the dead driver's straggling prepare
+        r = send("acct_b", tx_op("prepare", txid, vals=["7"]))
+        assert not r["ok"] and r["resolved"] == ABORTED
+    finally:
+        c.close()
+
+
+# ---------------------------------------------------------------------------
+# the chaos family, smoke-sized (the full campaign lives in test_chaos)
+# ---------------------------------------------------------------------------
+
+
+def test_txn_soak_smoke():
+    from gigapaxos_tpu.testing.chaos import run_txn_soak
+
+    r = run_txn_soak(11, rounds=120, settle_budget_s=300.0)
+    assert r["txns"] >= 1 and r["committed"] >= 1, r
