@@ -51,6 +51,20 @@ NORTH_STAR = 10_000_000.0  # decisions/s, BASELINE.json
 CAPACITY_G = 2_097_152     # the reference's PINSTANCES_CAPACITY wall
 
 
+def bench_provenance(donate=None) -> dict:
+    """Provenance stamp for bench artifacts (obs/device.py): jax/jaxlib
+    versions, platform, XLA flags, donation.  A perf number without its
+    software/hardware coordinates can't be compared across rounds —
+    ``scripts/perf_baseline.py`` keys its trend series on this block.
+    Never fails the bench: degrades to an ``error`` marker."""
+    try:
+        from gigapaxos_tpu.obs.device import provenance
+
+        return provenance(donate=donate)
+    except Exception as e:  # noqa: BLE001 — bench must still print its line
+        return {"error": repr(e)}
+
+
 def probe_tpu(timeout_s: float) -> tuple:
     """Probe whether the TPU backend can actually initialize — in a
     SUBPROCESS, because a broken tunnel makes backend init hang forever
@@ -218,10 +232,14 @@ def _run_group_sharded_point(n_devices: int, g_per_dev: int, W: int, K: int,
         )
         return states, committed.sum()
 
-    # warmup: compile + pipeline fill
+    # warmup: compile + pipeline fill — timed SEPARATELY so the artifact
+    # splits one-time compile cost from the steady-state rate (a compile
+    # regression and a throughput regression are different bugs)
+    tw = time.perf_counter()
     states, _ = run_chunk(states)
     states, c = run_chunk(states)
     jax.block_until_ready(c)
+    warmup_s = time.perf_counter() - tw
 
     t0 = time.perf_counter()
     total = 0
@@ -243,6 +261,7 @@ def _run_group_sharded_point(n_devices: int, g_per_dev: int, W: int, K: int,
         "per_device_hbm_peak_bytes": max(known) if known else None,
         "hbm_peak_bytes_by_device": peaks,
         "steps_timed": n_chunks * CHUNK,
+        "warmup_s": round(warmup_s, 2),
         "wall_s": round(dt, 2),
     }
 
@@ -273,10 +292,13 @@ def _dispatch_arm(n_steps: int, G: int, W: int, K: int, R: int,
         )
     want = jnp.zeros((R, G), bool)
     dispatches = substeps // n_steps
-    # warmup: compile + steady-state fill (untimed)
+    # warmup: compile + steady-state fill — timed into its own field so
+    # compile cost never leaks into (or hides inside) the steady rate
+    tw = time.perf_counter()
     for _ in range(2):
         states, out = step_fn(states, ring, want)
     jax.block_until_ready(out.n_committed)
+    warmup_s = time.perf_counter() - tw
 
     t0 = time.perf_counter()
     decided = 0
@@ -291,6 +313,7 @@ def _dispatch_arm(n_steps: int, G: int, W: int, K: int, R: int,
         "host_dispatches": dispatches,
         "substeps": dispatches * n_steps,
         "decided": decided,
+        "warmup_s": round(warmup_s, 3),
         "wall_s": round(dt, 3),
         "decided_per_s": round(decided / dt, 1),
         "dispatch_amortized_us": round(1e6 * dt / dispatches / n_steps, 1),
@@ -392,6 +415,7 @@ def dispatch_ablation_main() -> int:
             arm8["decided_per_s"] / arm1["decided_per_s"], 3
         ),
         "parity": parity,
+        "provenance": bench_provenance(donate=True),
         "wall_s": round(time.perf_counter() - t_start, 1),
     }
     out_path = os.environ.get("BENCH_DISPATCH_OUT") or os.path.join(
@@ -516,6 +540,7 @@ def multichip_main() -> int:
             "efficiency_parallel_model": round(eff_parallel, 3),
             "efficiency_serialized_model": round(eff_serialized, 3),
         },
+        "provenance": bench_provenance(donate=True),
         "wall_s": round(time.perf_counter() - t_start, 1),
     }
     out_path = os.environ.get("BENCH_MULTICHIP_OUT") or os.path.join(
@@ -651,10 +676,13 @@ def main() -> None:
     # an OOM there is a RESULT to record, not a crash to swallow.
     is_capacity = G == CAPACITY_G
     try:
-        # Warmup: compile + reach steady state (pipeline fill).
+        # Warmup: compile + reach steady state (pipeline fill) — timed
+        # into its own artifact field, separate from the steady rate
+        tw = time.perf_counter()
         states, _ = run_chunk(states, jnp.int32(0))
         states, c = run_chunk(states, jnp.int32(CHUNK))
         jax.block_until_ready(c)
+        warmup_s = time.perf_counter() - tw
 
         t0 = time.perf_counter()
         total = 0
@@ -696,6 +724,9 @@ def main() -> None:
         "unit": f"decisions/s ({G} groups, 3 replicas, 1 chip, "
                 f"{mode}, {platform})",
         "vs_baseline": round(rate / NORTH_STAR, 3),
+        "warmup_s": round(warmup_s, 2),
+        "steady_s": round(dt, 2),
+        "provenance": bench_provenance(donate=True),
     }
     if is_capacity:
         peaks = [p for p in device_hbm_peak(devs[:1]) if p is not None]

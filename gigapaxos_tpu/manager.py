@@ -336,16 +336,32 @@ class PaxosManager:
             1, Config.get_int(PC.ENGINE_STEPS_PER_DISPATCH)
         )
         # the ONE unified step (parallel/spmd.py:make_step), packed-host
-        # flavor; instances are memoized by (cfg, N, donate), so jit
-        # caches are shared across managers with the same shape
+        # flavor; instances are memoized by (cfg, N, donate, heat), so
+        # jit caches are shared across managers with the same shape.
+        # heat=True threads the [G] device-resident activity accumulator
+        # through every dispatch (decisions + admissions per group,
+        # folded across substeps inside the device loop); the host pulls
+        # it only at the stats cadence (pull_group_heat), never per tick
         self._dispatch_step = make_step(
             cfg, None, self.steps_per_dispatch, donate=True,
-            io="packed_host",
+            io="packed_host", heat=True,
         )
         self._tick_step = make_step(
             cfg, None, self.steps_per_dispatch, donate=False,
-            io="packed_host",
+            io="packed_host", heat=True,
         )
+        # retrace sentinel bookkeeping (obs/device.py): the sentinels are
+        # SHARED across managers of the same shape, so per-node metrics
+        # count deltas against the last totals this manager saw; the
+        # sentinels are marked warm after this manager's first completed
+        # dispatch — any compile after that is a retrace (hard invariant:
+        # the hot dispatch never retraces after warmup)
+        self._compile_seen = 0
+        self._retrace_seen = 0
+        # device-resident [G] group-activity accumulator + the host-side
+        # cumulative view refreshed by pull_group_heat at stats cadence
+        self._heat_dev = jnp.zeros((G,), jnp.int32)
+        self._heat_host = np.zeros(G, np.int64)
         # vids staged into the device request ring by the LAST dispatch
         # (the device_queue_depth gauge)
         self._last_ring_depth = 0
@@ -881,6 +897,69 @@ class PaxosManager:
         from .parallel.mesh import describe_state_mesh
 
         return describe_state_mesh(self.state.bal)
+
+    # ------------------------------------------------------------------
+    # device-plane observatory (obs/device.py)
+    # ------------------------------------------------------------------
+    def pull_group_heat(self) -> np.ndarray:
+        """Drain the device-resident ``[G]`` activity accumulator.
+
+        THE one sanctioned device pull outside the `_np` leaf cache —
+        stats-cadence only (the server's stats line / the `stats` admin
+        op), never from a hot-path function: it synchronizes with an
+        in-flight dispatch.  Returns the per-group delta since the last
+        pull, folds it into the cumulative host view and the
+        ``group_heat*`` metrics, and resets the device accumulator."""
+        from .obs.device import HEAT_BOUNDS, heat_summary
+
+        with self._state_lock:
+            arr = np.asarray(self._heat_dev)  # syncs; GIL released
+            if arr.base is not None:
+                # the next dispatch donates this buffer — copy first
+                arr = arr.copy()
+            self._heat_dev = jnp.zeros(
+                (self.cfg.n_groups,), jnp.int32
+            )
+            delta = arr.astype(np.int64)
+            self._heat_host += delta
+            cum = self._heat_host
+        mx = self.metrics
+        total = int(delta.sum())
+        if total:
+            mx.count("group_heat_total", total)
+            mx.observe_bulk(
+                "group_heat", delta[delta > 0], bounds=HEAT_BOUNDS
+            )
+        summ = heat_summary(cum)
+        mx.gauge("group_heat_active_groups", summ["active_groups"])
+        mx.gauge(
+            "group_heat_top1pct_share",
+            summ["hot_set"]["traffic_share"],
+        )
+        return delta
+
+    def group_heat_stats(self, topk: Optional[int] = None) -> Dict:
+        """The ``engine.heat`` stats block: top-K rows by cumulative
+        activity (named where this node hosts the row) and the hot-set
+        estimate the density campaign reads.  Pure host arithmetic over
+        the last pulled view — call :meth:`pull_group_heat` first for a
+        fresh one."""
+        from .obs.device import heat_summary
+
+        if topk is None:
+            topk = Config.get_int(PC.GROUP_HEAT_TOPK)
+        with self._state_lock:
+            cum = self._heat_host.copy()
+        return heat_summary(cum, topk=topk, name_of=self.row_name.get)
+
+    def engine_compile_stats(self) -> Dict:
+        """The ``engine.compile`` stats block: compile/retrace counts of
+        this manager's two step instances (shared across same-shape
+        managers in-process) plus their last recorded events."""
+        return {
+            "dispatch": self._dispatch_step.stats(),
+            "tick": self._tick_step.stats(),
+        }
 
     def local_read_ok(self, name: str) -> bool:
         """Gate for the uncoordinated local-read fast path: False while
@@ -2534,11 +2613,13 @@ class PaxosManager:
                     arr = np.asarray(getattr(old_state, leaf))
                     carry[leaf] = arr.copy() if arr.base is not None else arr
             t0 = time.monotonic()
-            new_state, out_vec, blob_vec = self._dispatch_step(
+            new_state, out_vec, blob_vec, new_heat = self._dispatch_step(
                 old_state, jnp.asarray(gathered_vec), jnp.asarray(heard),
                 jnp.asarray(req), jnp.asarray(wc), jnp.int32(self.my_id),
+                self._heat_dev,
             )
             self.state = new_state
+            self._heat_dev = new_heat
             self._np_cache = carry
             self._np_cache_state = new_state
             self._step_inflight = True
@@ -2593,11 +2674,13 @@ class PaxosManager:
             else np.asarray(want_coord, bool)
         )
         t0 = time.monotonic()
-        new_state, out_vec, blob_vec = self._dispatch_step(
+        new_state, out_vec, blob_vec, new_heat = self._dispatch_step(
             self.state, jnp.asarray(gathered_vec), jnp.asarray(heard),
             jnp.asarray(req), jnp.asarray(wc), jnp.int32(self.my_id),
+            self._heat_dev,
         )
         self.state = new_state
+        self._heat_dev = new_heat
         out_np_vec = np.asarray(out_vec)  # one transfer; forces the sync
         DelayProfiler.update_delay("engine_step", t0)
         self.last_engine_step_s = time.monotonic() - t0
@@ -2626,11 +2709,13 @@ class PaxosManager:
         # alias the live state across ticks
         gvec = _pack_rows_jit(gathered)
         t0 = time.monotonic()
-        new_state, out_vec, blob_vec = self._tick_step(
+        new_state, out_vec, blob_vec, new_heat = self._tick_step(
             self.state, gvec, jnp.asarray(heard),
             jnp.asarray(req), jnp.asarray(wc), jnp.int32(self.my_id),
+            self._heat_dev,
         )
         self.state = new_state
+        self._heat_dev = new_heat
         out_np_vec = np.asarray(out_vec)  # one transfer; forces the sync
         # update_delay takes the START time (it computes monotonic()-t0)
         DelayProfiler.update_delay("engine_step", t0)
@@ -2716,6 +2801,27 @@ class PaxosManager:
         mx.observe(
             "dispatch_amortized_s", self.last_engine_step_s / n_sub
         )
+        # retrace sentinel: fold the shared sentinels' totals into this
+        # node's counters as deltas (attribute reads only — no device
+        # traffic), and mark them warm after the first completed
+        # dispatch.  A retrace after warmup is the recompile analog of a
+        # stray hot-path _np pull: it still WORKS, ~100x slower — so it
+        # is shouted into the log, not just a metric
+        n_c = self._dispatch_step.n_compiles + self._tick_step.n_compiles
+        n_r = self._dispatch_step.n_retraces + self._tick_step.n_retraces
+        if n_c != self._compile_seen:
+            mx.count("engine_compiles", n_c - self._compile_seen)
+            self._compile_seen = n_c
+        if n_r != self._retrace_seen:
+            mx.count("engine_retraces", n_r - self._retrace_seen)
+            self._retrace_seen = n_r
+            self.log.error(
+                "engine step RETRACED after warmup (%d total): %s",
+                n_r, self._dispatch_step.stats(),
+            )
+        if not self._dispatch_step.warm:
+            self._dispatch_step.mark_warm()
+            self._tick_step.mark_warm()
         # flight recorder: the per-step summary ring (always on; skips
         # pure-idle ticks internally so the ring spans real history)
         self.flight.record_step(

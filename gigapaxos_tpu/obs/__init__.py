@@ -16,12 +16,26 @@ package recreates all three for the array-world runtime:
   per-step engine aggregates (decisions, preempts, coordinator flips,
   frontier stalls, blob bytes), complementing the EWMA-only
   :class:`~gigapaxos_tpu.utils.profiler.DelayProfiler`.
+* :mod:`.device` — the device-plane observatory: the retrace/compile
+  sentinel every ``make_step`` instance is wrapped in, group-heat
+  analysis for the on-device activity accumulator, AOT cost
+  attribution, bounded ``jax.profiler`` captures, and the provenance
+  stamp bench/capacity artifacts carry.
 
 This package is the ONLY place in ``gigapaxos_tpu`` allowed to write to
 stderr directly (enforced by ``scripts/check_obs_hygiene.py``); every
 other module routes diagnostics through :func:`gplog.get_logger`.
 """
 
+from .device import (  # noqa: F401
+    StepSentinel,
+    capture_profile,
+    compile_stats,
+    device_memory_stats,
+    heat_summary,
+    provenance,
+    step_cost,
+)
 from .gplog import configure, get_logger, node_logger, warn_once  # noqa: F401
 from .metrics import Histogram, MetricsRegistry  # noqa: F401
 from .reqtrace import RequestTracer, trace_enabled  # noqa: F401

@@ -1,0 +1,454 @@
+"""Device-plane observability: the compiled engine step, introspected.
+
+The host plane got Dapper-style traces, per-phase histograms and a
+flight recorder in earlier PRs; this module points the same instruments
+at the jitted engine itself:
+
+* :class:`StepSentinel` — wraps every ``make_step`` instance so each
+  XLA lowering/compile is *recorded* (arg-shape fingerprint, wall time,
+  cache hit/miss) instead of silently eaten.  The deployed manager
+  marks its sentinels warm after the first completed dispatch; any
+  compile after that is a **retrace** — the recompile analog of the
+  stray-``_np`` class of hot-path bug, surfaced as the
+  ``engine_retraces`` metric and an ERROR log line rather than as a
+  mystery 100x tick.
+
+* group-heat analysis (:func:`heat_summary`, :data:`HEAT_BOUNDS`) —
+  folds the device-side per-group activity accumulator into log-bucket
+  histograms, a top-K table and a machine-readable hot-set estimate
+  (fraction of traffic landing in the top 1% of rows) for the
+  group-density campaign.
+
+* cost attribution (:func:`step_cost`, :func:`device_memory_stats`,
+  :func:`capture_profile`) — AOT ``cost_analysis()`` FLOPs/bytes,
+  per-device HBM high-water, and on-demand ``jax.profiler`` traces into
+  a bounded dump directory (rotation like the flight recorder's).
+
+* :func:`provenance` — the jax/jaxlib/platform/XLA-flags/donation
+  stamp every bench/capacity artifact carries so a number can always be
+  tied to the toolchain that produced it.
+
+jax itself is imported lazily (only by the functions that need it) so
+client-side processes importing :mod:`gigapaxos_tpu.obs` don't pay for
+a backend init.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+__all__ = [
+    "StepSentinel",
+    "all_sentinels",
+    "compile_stats",
+    "arg_fingerprint",
+    "HEAT_BOUNDS",
+    "heat_summary",
+    "provenance",
+    "step_cost",
+    "device_memory_stats",
+    "capture_profile",
+    "ProfileBusy",
+]
+
+
+# ---------------------------------------------------------------------------
+# retrace/compile sentinel
+# ---------------------------------------------------------------------------
+
+
+def arg_fingerprint(args: Sequence[Any], kwargs: Optional[Dict] = None):
+    """Hashable (shape, dtype) fingerprint of a call's arguments.
+
+    Arrays collapse to ``(shape, dtype)`` — exactly the part of a call
+    signature that drives jit cache identity for this codebase (configs
+    are static, weak types don't arise: the engine is all-int32) — so
+    two calls with the same fingerprint hitting two compiles is the
+    definition of a retrace."""
+
+    def one(x):
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            return ("a", tuple(x.shape), str(x.dtype))
+        if isinstance(x, (tuple, list)):
+            return tuple(one(v) for v in x)
+        if isinstance(x, dict):
+            return tuple(sorted((k, one(v)) for k, v in x.items()))
+        return ("p", type(x).__name__, repr(x)[:32])
+
+    fp = tuple(one(a) for a in args)
+    if kwargs:
+        fp += (tuple(sorted((k, one(v)) for k, v in kwargs.items())),)
+    return fp
+
+
+# every live sentinel, in creation order — make_step memoizes instances,
+# so this is bounded by the number of distinct (cfg, mesh, N, donate,
+# io, heat) shapes a process ever builds, not by call volume
+_SENTINELS: List["StepSentinel"] = []
+_SENTINELS_LOCK = threading.Lock()
+
+
+class StepSentinel:
+    """Transparent wrapper around a jitted step: records every compile.
+
+    Detection is the jit cache size (``fn._cache_size()``) sampled after
+    each call — one attribute call + int compare on the hot path, no
+    tree traversal unless a compile actually happened.  Where the cache
+    probe is unavailable (exotic wrappers), detection falls back to
+    first-sight arg fingerprints.
+
+    Semantics:
+
+    * every cache growth is a **compile** (``n_compiles``);
+    * a compile for a fingerprint this sentinel has *already seen*, or
+      any compile after :meth:`mark_warm`, is additionally a
+      **retrace** (``n_retraces``) — the hard invariant for the
+      deployed hot dispatch is ``n_retraces == 0`` forever.
+
+    Attribute access falls through to the wrapped function, so
+    ``.lower(...)`` / AOT cost attribution keep working.
+    """
+
+    def __init__(self, fn: Callable, label: str = "",
+                 max_events: int = 64):
+        self._fn = fn
+        self.label = label or getattr(fn, "__name__", "step")
+        self._lock = threading.Lock()
+        self._probe = getattr(fn, "_cache_size", None)
+        self._seen_cache = self._cache_size()
+        self._fingerprints: set = set()
+        self._events: deque = deque(maxlen=max_events)
+        self.n_compiles = 0
+        self.n_retraces = 0
+        self.warm = False
+        with _SENTINELS_LOCK:
+            _SENTINELS.append(self)
+
+    # -- plumbing ---------------------------------------------------------
+
+    def _cache_size(self) -> int:
+        if self._probe is None:
+            return -1
+        try:
+            return int(self._probe())
+        except Exception:
+            return -1
+
+    def __getattr__(self, name):
+        # transparent: .lower / .trace / anything jit-ish reaches the
+        # wrapped function (note __getattr__ only fires on misses)
+        return getattr(self._fn, name)
+
+    @property
+    def fn(self) -> Callable:
+        """The wrapped (jitted) function."""
+        return self._fn
+
+    # -- the hot path -----------------------------------------------------
+
+    def __call__(self, *args, **kwargs):
+        t0 = time.perf_counter()
+        out = self._fn(*args, **kwargs)
+        wall = time.perf_counter() - t0
+        size = self._cache_size()
+        if size >= 0:
+            if size > self._seen_cache:
+                with self._lock:
+                    delta = size - self._seen_cache
+                    if delta > 0:
+                        self._seen_cache = size
+                        self._record(args, kwargs, wall, delta)
+        else:
+            fp = arg_fingerprint(args, kwargs)
+            if fp not in self._fingerprints:
+                with self._lock:
+                    if fp not in self._fingerprints:
+                        self._record(args, kwargs, wall, 1, fp=fp)
+        return out
+
+    def _record(self, args, kwargs, wall: float, n: int, fp=None) -> None:
+        # lock held.  wall is the triggering call's total time — on a
+        # cache miss that IS trace+lower+compile (plus one execute), the
+        # number an operator needs when a retrace storm eats a soak
+        fp = arg_fingerprint(args, kwargs) if fp is None else fp
+        seen_before = fp in self._fingerprints
+        self._fingerprints.add(fp)
+        retrace = (self.n_compiles > 0) and (self.warm or seen_before)
+        self.n_compiles += n
+        if retrace:
+            self.n_retraces += n
+        self._events.append({
+            "label": self.label,
+            "kind": "retrace" if retrace else "compile",
+            "fingerprint": repr(fp),
+            "wall_s": wall,
+            "cache_size": self._seen_cache,
+            "warm": self.warm,
+            "t": time.time(),
+        })
+
+    # -- the invariant ----------------------------------------------------
+
+    def mark_warm(self) -> None:
+        """Declare warmup over: every compile from here on is a retrace."""
+        self.warm = True
+
+    def assert_no_retraces(self) -> None:
+        """Raise if any retrace was ever observed (test-side invariant)."""
+        if self.n_retraces:
+            raise RuntimeError(
+                f"{self.label}: {self.n_retraces} retrace(s) observed: "
+                f"{list(self._events)}"
+            )
+
+    # -- export -----------------------------------------------------------
+
+    def events(self) -> List[Dict]:
+        with self._lock:
+            return list(self._events)
+
+    def stats(self) -> Dict:
+        with self._lock:
+            last = self._events[-1] if self._events else None
+            return {
+                "label": self.label,
+                "compiles": self.n_compiles,
+                "retraces": self.n_retraces,
+                "warm": self.warm,
+                "cache_size": self._seen_cache,
+                "last": dict(last) if last else None,
+            }
+
+
+def all_sentinels() -> List[StepSentinel]:
+    with _SENTINELS_LOCK:
+        return list(_SENTINELS)
+
+
+def compile_stats() -> Dict:
+    """Process-wide compile picture over every memoized step instance
+    (the ``engine.compile`` stats block)."""
+    sents = all_sentinels()
+    return {
+        "compiles": sum(s.n_compiles for s in sents),
+        "retraces": sum(s.n_retraces for s in sents),
+        "instances": [s.stats() for s in sents],
+    }
+
+
+# ---------------------------------------------------------------------------
+# group heat analysis (host side of the on-device [G] accumulator)
+# ---------------------------------------------------------------------------
+
+# log-spaced COUNT buckets (decisions+admissions per group per stats
+# window) — not the seconds DEFAULT_BOUNDS of latency histograms
+HEAT_BOUNDS = (
+    1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0,
+    256.0, 512.0, 1024.0, 4096.0, 16384.0, 65536.0,
+)
+
+
+def heat_summary(heat, topk: int = 8,
+                 name_of: Optional[Callable[[int], Optional[str]]] = None,
+                 ) -> Dict:
+    """Fold a cumulative per-group activity vector into the stats shape.
+
+    Returns ``{"total", "active_groups", "top_groups": [{row, heat,
+    name?}], "hot_set": {"rows", "pct_of_groups", "traffic_share"}}``
+    where ``hot_set.traffic_share`` is the fraction of all activity
+    carried by the top 1% of rows — the machine-readable skew estimate
+    the density campaign consumes (a near-1.0 share says row capacity,
+    not aggregate throughput, is the binding constraint)."""
+    import numpy as np
+
+    heat = np.asarray(heat, np.int64)
+    total = int(heat.sum())
+    active = int((heat > 0).sum())
+    order = np.argsort(heat, kind="stable")[::-1]
+    top: List[Dict] = []
+    for g in order[: max(0, int(topk))]:
+        h = int(heat[g])
+        if h <= 0:
+            break
+        row: Dict = {"row": int(g), "heat": h}
+        if name_of is not None:
+            nm = name_of(int(g))
+            if nm is not None:
+                row["name"] = nm
+        top.append(row)
+    n_hot = max(1, -(-len(heat) // 100))  # ceil(G / 100)
+    share = (
+        float(heat[order[:n_hot]].sum()) / total if total else 0.0
+    )
+    return {
+        "total": total,
+        "active_groups": active,
+        "top_groups": top,
+        "hot_set": {
+            "rows": n_hot,
+            "pct_of_groups": 1.0,
+            "traffic_share": share,
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# provenance + cost attribution
+# ---------------------------------------------------------------------------
+
+
+def provenance(donate: Optional[bool] = None,
+               extra: Optional[Dict] = None) -> Dict:
+    """The toolchain stamp for bench/capacity artifacts: jax/jaxlib
+    versions, live platform, XLA flags, donation status.  JSON-pure."""
+    import platform as _platform
+
+    import jax
+    import jaxlib
+
+    devs = jax.devices()
+    out = {
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "backend": jax.default_backend(),
+        "platform": devs[0].platform if devs else "none",
+        "device_kind": devs[0].device_kind if devs else "none",
+        "n_devices": len(devs),
+        "xla_flags": os.environ.get("XLA_FLAGS", ""),
+        "jax_platforms": os.environ.get("JAX_PLATFORMS", ""),
+        "python": _platform.python_version(),
+        "donation": donate,
+    }
+    if extra:
+        out.update(extra)
+    return out
+
+
+def step_cost(fn: Callable, *args) -> Dict:
+    """AOT cost attribution for one step instance: explicit
+    ``lower() -> compile()`` with the two wall times split out, plus
+    XLA's ``cost_analysis()`` FLOPs/bytes and ``memory_analysis()``
+    buffer sizes.  Accepts a :class:`StepSentinel` or a raw jitted fn;
+    the AOT pipeline does not touch the jit dispatch cache, so running
+    this never perturbs the sentinel's counts."""
+    target = fn.fn if isinstance(fn, StepSentinel) else fn
+    t0 = time.perf_counter()
+    lowered = target.lower(*args)
+    t1 = time.perf_counter()
+    compiled = lowered.compile()
+    t2 = time.perf_counter()
+    out: Dict = {"lowering_s": t1 - t0, "compile_s": t2 - t1}
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        out["flops"] = float(ca.get("flops", -1.0))
+        out["bytes_accessed"] = float(ca.get("bytes accessed", -1.0))
+    except Exception:
+        out["flops"] = out["bytes_accessed"] = -1.0
+    try:
+        ma = compiled.memory_analysis()
+        out["memory"] = {
+            k: int(getattr(ma, k))
+            for k in (
+                "temp_size_in_bytes", "argument_size_in_bytes",
+                "output_size_in_bytes", "alias_size_in_bytes",
+                "generated_code_size_in_bytes",
+            )
+            if hasattr(ma, k)
+        }
+    except Exception:
+        out["memory"] = {}
+    return out
+
+
+def device_memory_stats() -> Dict[str, Dict[str, int]]:
+    """Per-device ``memory_stats()`` (HBM high-water among them), keyed
+    by device id.  Empty on backends that expose none (CPU)."""
+    import jax
+
+    out: Dict[str, Dict[str, int]] = {}
+    for d in jax.devices():
+        try:
+            ms = d.memory_stats()
+        except Exception:
+            ms = None
+        if ms:
+            out[str(d.id)] = {
+                k: int(v) for k, v in ms.items() if isinstance(v, int)
+            }
+    return out
+
+
+# ---------------------------------------------------------------------------
+# on-demand profiler capture (bounded dump directory)
+# ---------------------------------------------------------------------------
+
+
+class ProfileBusy(RuntimeError):
+    """A capture is already running in this process (jax.profiler is a
+    process-global singleton — two concurrent traces corrupt both)."""
+
+
+_PROFILE_LOCK = threading.Lock()
+_PROFILE_SEQ = [0]
+
+
+def _rotate_dumps(root: str, max_dumps: int) -> int:
+    """Keep the newest ``max_dumps`` capture dirs under ``root`` (the
+    flight recorder's rotation rule): a soak poking ``profile`` in a
+    loop cannot grow the directory unboundedly.  Returns removals."""
+    try:
+        entries = [
+            os.path.join(root, e) for e in os.listdir(root)
+            if os.path.isdir(os.path.join(root, e))
+        ]
+    except OSError:
+        return 0
+    entries.sort(key=lambda p: os.path.getmtime(p))
+    removed = 0
+    while len(entries) > max(1, int(max_dumps)):
+        victim = entries.pop(0)
+        shutil.rmtree(victim, ignore_errors=True)
+        removed += 1
+    return removed
+
+
+def capture_profile(out_dir: str, seconds: float = 0.25,
+                    max_dumps: int = 8, max_seconds: float = 5.0) -> Dict:
+    """Capture a ``jax.profiler`` trace of whatever the process is doing
+    for ``seconds`` (clamped to ``max_seconds`` — an admin op must not
+    park a transport thread for minutes), into a fresh subdirectory of
+    ``out_dir``, then rotate the directory down to ``max_dumps``.
+
+    Raises :class:`ProfileBusy` when a capture is already in flight."""
+    import jax
+
+    seconds = min(max(float(seconds), 0.01), float(max_seconds))
+    if not _PROFILE_LOCK.acquire(blocking=False):
+        raise ProfileBusy("a profiler capture is already running")
+    try:
+        _PROFILE_SEQ[0] += 1
+        stamp = time.strftime("%Y%m%d-%H%M%S")
+        dump = os.path.join(
+            out_dir, f"profile-{stamp}-{os.getpid()}-{_PROFILE_SEQ[0]}"
+        )
+        os.makedirs(dump, exist_ok=True)
+        t0 = time.perf_counter()
+        jax.profiler.start_trace(dump)
+        try:
+            time.sleep(seconds)
+        finally:
+            jax.profiler.stop_trace()
+        wall = time.perf_counter() - t0
+        removed = _rotate_dumps(out_dir, max_dumps)
+        return {
+            "dir": dump, "seconds": wall, "rotated_out": removed,
+        }
+    finally:
+        _PROFILE_LOCK.release()

@@ -117,6 +117,39 @@ class MetricsRegistry:
                 h = self._hists[key] = Histogram(bounds)
             h.observe(x)
 
+    def observe_bulk(self, key: str, values,
+                     bounds: Optional[Sequence[float]] = None) -> None:
+        """Fold MANY histogram samples under one lock acquisition — the
+        stats-cadence face of :meth:`observe` for vectorized sources
+        (the ``group_heat`` pull hands over one value per active group;
+        taking the lock per group would make the stats tick O(G) lock
+        traffic).  Bucketing is vectorized via numpy when available;
+        ``bounds`` is first-wins exactly like :meth:`observe`."""
+        vals = list(values) if not hasattr(values, "__len__") else values
+        if len(vals) == 0:
+            return
+        with self._lock:
+            h = self._hists.get(key)
+            if h is None:
+                h = self._hists[key] = Histogram(bounds)
+            try:
+                import numpy as np
+
+                arr = np.asarray(vals, np.float64)
+                idx = np.searchsorted(
+                    np.asarray(h.bounds, np.float64), arr, side="left"
+                )
+                for i, n in zip(*np.unique(idx, return_counts=True)):
+                    h.buckets[int(i)] += int(n)
+                h.count += int(arr.size)
+                h.total += float(arr.sum())
+                lo, hi = float(arr.min()), float(arr.max())
+                h.min = lo if h.min is None or lo < h.min else h.min
+                h.max = hi if h.max is None or hi > h.max else h.max
+            except ImportError:
+                for x in vals:
+                    h.observe(x)
+
     def remove(self, key: str) -> None:
         """Retire a metric series (e.g. a per-node gauge of a removed
         cluster member): a dead label exporting its last value forever

@@ -121,6 +121,62 @@ def phase_totals(trace: Dict) -> Dict[str, float]:
     return acc
 
 
+def parse_slo_budgets(spec: str) -> Dict[str, float]:
+    """Parse a ``phase=ms`` CSV (the ``SLO_BUDGETS_MS`` flag / the
+    ``gp_trace --slo`` argument) into ``{phase: budget_seconds}``.
+
+    Phase names must be merged-trace labels (:data:`PHASE_LABELS`
+    values) or the pseudo-phase ``total`` (the trace's end-to-end wall
+    time) — an unknown name raises: a typoed budget that silently never
+    fires is worse than no budget."""
+    known = set(PHASE_LABELS.values()) | {"total"}
+    budgets: Dict[str, float] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        phase, sep, ms = part.partition("=")
+        phase = phase.strip()
+        if not sep:
+            raise ValueError(f"SLO budget {part!r}: expected phase=ms")
+        if phase not in known:
+            raise ValueError(
+                f"SLO budget names unknown phase {phase!r} "
+                f"(known: {', '.join(sorted(known))})"
+            )
+        budgets[phase] = float(ms) / 1e3
+    return budgets
+
+
+def default_slo_budgets(spec: Optional[str] = None) -> Dict[str, float]:
+    """Resolve SLO budgets from an explicit spec, falling back to the
+    ``SLO_BUDGETS_MS`` flag (so a scenario's properties file sets the
+    cluster's budgets and ``gp_trace --slo`` with no argument uses
+    them)."""
+    if not spec:
+        from gigapaxos_tpu.paxos_config import PC
+        from gigapaxos_tpu.utils.config import Config
+
+        spec = Config.get_str(PC.SLO_BUDGETS_MS)
+    return parse_slo_budgets(spec)
+
+
+def slo_breaches(trace: Dict, budgets: Dict[str, float]) -> List[Dict]:
+    """Evaluate one merged trace against per-phase budgets: every phase
+    whose aggregated latency exceeds its budget, plus the ``total``
+    pseudo-phase against end-to-end wall time.  Returns
+    ``[{phase, dt_s, budget_s}]`` (empty = within SLO)."""
+    totals = phase_totals(trace)
+    totals["total"] = float(trace.get("total_s", 0.0))
+    out: List[Dict] = []
+    for phase, budget_s in budgets.items():
+        dt = totals.get(phase)
+        if dt is not None and dt > budget_s:
+            out.append({"phase": phase, "dt_s": dt, "budget_s": budget_s})
+    out.sort(key=lambda b: b["budget_s"] - b["dt_s"])
+    return out
+
+
 def render_trace(trace: Dict) -> str:
     """One merged timeline as text: every hop's event with its node and
     relative time, then the per-phase attribution."""

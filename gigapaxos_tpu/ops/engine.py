@@ -105,6 +105,7 @@ from __future__ import annotations
 
 from typing import NamedTuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
@@ -426,11 +427,17 @@ def step(
     i_member = ((state.member_mask >> my_id) & 1) == 1
 
     # ---- 1. promise update (handlePrepare / acceptAndUpdateBallot) ----
-    prep_bal_g, prop_bal_g = _decode_coord(g.coord)       # [R, G]
-    in_prep = jnp.where(live, prep_bal_g, NULL)
-    in_prop = jnp.where(live, prop_bal_g, NULL)
-    max_prop = in_prop.max(axis=0)                        # [G]
-    new_bal = jnp.maximum(state.bal, jnp.maximum(in_prep.max(axis=0), max_prop))
+    # (named_scope blocks annotate the HLO/profiler view of the step
+    # with the consensus phase each op belongs to — trace-time only,
+    # zero runtime cost; scripts/… profile captures read them back)
+    with jax.named_scope("gp.promise"):
+        prep_bal_g, prop_bal_g = _decode_coord(g.coord)   # [R, G]
+        in_prep = jnp.where(live, prep_bal_g, NULL)
+        in_prop = jnp.where(live, prop_bal_g, NULL)
+        max_prop = in_prop.max(axis=0)                    # [G]
+        new_bal = jnp.maximum(
+            state.bal, jnp.maximum(in_prep.max(axis=0), max_prop)
+        )
 
     exec2 = state.exec_slot[:, None]
 
@@ -487,32 +494,42 @@ def step(
         c1_v = jnp.where(lower, d_vid, c1_v)
         return (p_slot, p_vid, s_c, b_c, det_vid, n_match, c1_s, c1_v)
 
-    (p_slot, p_vid, s_c, b_c, det_vid, n_match, c1_s, c1_v) = lax.fori_loop(
-        0, R, fold_peers,
-        (
-            nullw, nullw,                                  # accept winner
-            nullw, nullw, nullw, jnp.zeros((G, W), jnp.int32),  # learn
-            jnp.full((G, W), _BIG, jnp.int32), nullw,      # decision merge
-        ),
-    )
+    with jax.named_scope("gp.peer_fold"):
+        (p_slot, p_vid, s_c, b_c, det_vid, n_match, c1_s, c1_v) = \
+            lax.fori_loop(
+                0, R, fold_peers,
+                (
+                    nullw, nullw,                          # accept winner
+                    nullw, nullw, nullw,
+                    jnp.zeros((G, W), jnp.int32),          # learn
+                    jnp.full((G, W), _BIG, jnp.int32),
+                    nullw,                                 # decision merge
+                ),
+            )
     detected = (n_match >= maj[:, None]) & (s_c != NULL)
 
     # ---- 2. accept (handleAccept, PaxosAcceptor.acceptAndUpdateBallot) ----
     # Highest-ballot proposer wins; its ballot must equal the new promise.
-    acc_ok = (max_prop == new_bal) & (max_prop != NULL) & (state.stopped == 0)
-    # no ring-residue check needed: compact decode reconstructs every slot
-    # as (epoch << kbits) | lane, so residue matches its lane by construction
-    in_win = (p_slot >= exec2) & (p_slot < exec2 + W) & (p_vid != NULL)
-    do_acc = acc_ok[:, None] & in_win
-    acc_bal = jnp.where(do_acc, max_prop[:, None], state.acc_bal)
-    acc_vid = jnp.where(do_acc, p_vid, state.acc_vid)
-    acc_slot = jnp.where(do_acc, p_slot, state.acc_slot)
-    # True journal delta: an unchanged in-flight proposal re-fires do_acc
-    # every step until it decides — only a changed lane needs durability.
-    acc_changed = do_acc & (
-        (acc_bal != state.acc_bal) | (acc_vid != state.acc_vid)
-        | (acc_slot != state.acc_slot)
-    )
+    with jax.named_scope("gp.accept"):
+        acc_ok = (
+            (max_prop == new_bal) & (max_prop != NULL)
+            & (state.stopped == 0)
+        )
+        # no ring-residue check needed: compact decode reconstructs
+        # every slot as (epoch << kbits) | lane, so residue matches its
+        # lane by construction
+        in_win = (p_slot >= exec2) & (p_slot < exec2 + W) & (p_vid != NULL)
+        do_acc = acc_ok[:, None] & in_win
+        acc_bal = jnp.where(do_acc, max_prop[:, None], state.acc_bal)
+        acc_vid = jnp.where(do_acc, p_vid, state.acc_vid)
+        acc_slot = jnp.where(do_acc, p_slot, state.acc_slot)
+        # True journal delta: an unchanged in-flight proposal re-fires
+        # do_acc every step until it decides — only a changed lane needs
+        # durability.
+        acc_changed = do_acc & (
+            (acc_bal != state.acc_bal) | (acc_vid != state.acc_vid)
+            | (acc_slot != state.acc_slot)
+        )
 
     # ---- 3. learn (the BatchedAcceptReply->DECISION collapse) ----
     # Decision candidates per lane: keep the SMALLEST undecided-needed slot
@@ -521,58 +538,66 @@ def step(
         ok = valid & (slot != NULL) & (slot >= exec2)
         return jnp.where(ok, slot, _BIG), vid
 
-    c0_s, c0_v = cand(state.dec_slot, state.dec_vid, True)
-    c2_s, c2_v = cand(s_c, det_vid, detected)
+    with jax.named_scope("gp.learn"):
+        c0_s, c0_v = cand(state.dec_slot, state.dec_vid, True)
+        c2_s, c2_v = cand(s_c, det_vid, detected)
 
-    best = jnp.minimum(jnp.minimum(c0_s, c1_s), c2_s)
-    have = best < _BIG
-    dec_vid = jnp.where(
-        have,
-        jnp.where(best == c0_s, c0_v, jnp.where(best == c1_s, c1_v, c2_v)),
-        state.dec_vid,
-    )
-    dec_slot = jnp.where(have, best, state.dec_slot)
+        best = jnp.minimum(jnp.minimum(c0_s, c1_s), c2_s)
+        have = best < _BIG
+        dec_vid = jnp.where(
+            have,
+            jnp.where(
+                best == c0_s, c0_v,
+                jnp.where(best == c1_s, c1_v, c2_v),
+            ),
+            state.dec_vid,
+        )
+        dec_slot = jnp.where(have, best, state.dec_slot)
 
     # ---- 4. execute: advance the in-order frontier (EEC analog,
     # PaxosInstanceStateMachine.extractExecuteAndCheckpoint:1511-1593) ----
     # A lane holds frontier+o exactly when its decided slot equals it —
     # checked per offset with [G, W] temporaries (a static W unroll; the
     # [G, W, W] one-hot this replaces was a 4 GB transient at G=1M/W=32).
-    h = state.app_hash
-    n_execd = state.n_execd
-    stop_seen = jnp.zeros((G,), bool)
-    run_prev = jnp.ones((G,), bool)
-    n_adv = jnp.zeros((G,), jnp.int32)
-    run_cols = []
-    vid_cols = []
-    for o in range(W):  # static unroll; W small
-        slot_o = state.exec_slot + o
-        eq = dec_slot == slot_o[:, None]                  # [G, W]
-        hit = eq.any(axis=1)
-        vid_o = jnp.where(eq, dec_vid, NULL).max(axis=1)  # [G]
-        take = run_prev & hit
-        real = take & (vid_o > 0)
-        h = jnp.where(real, _mix(h, vid_o), h)
-        n_execd = n_execd + real.astype(jnp.int32)
-        stop_seen = stop_seen | (take & ((vid_o & STOP_BIT) != 0))
-        n_adv = n_adv + take.astype(jnp.int32)
-        run_cols.append(take)
-        vid_cols.append(vid_o)
-        run_prev = take
-    exec_new = state.exec_slot + n_adv
-    run = jnp.stack(run_cols, axis=1)                     # [G, W] bool
-    d_vid_at = jnp.stack(vid_cols, axis=1)                # [G, W]
-    stopped = jnp.maximum(state.stopped, stop_seen.astype(jnp.int32))
+    with jax.named_scope("gp.execute"):
+        h = state.app_hash
+        n_execd = state.n_execd
+        stop_seen = jnp.zeros((G,), bool)
+        run_prev = jnp.ones((G,), bool)
+        n_adv = jnp.zeros((G,), jnp.int32)
+        run_cols = []
+        vid_cols = []
+        for o in range(W):  # static unroll; W small
+            slot_o = state.exec_slot + o
+            eq = dec_slot == slot_o[:, None]              # [G, W]
+            hit = eq.any(axis=1)
+            vid_o = jnp.where(eq, dec_vid, NULL).max(axis=1)  # [G]
+            take = run_prev & hit
+            real = take & (vid_o > 0)
+            h = jnp.where(real, _mix(h, vid_o), h)
+            n_execd = n_execd + real.astype(jnp.int32)
+            stop_seen = stop_seen | (take & ((vid_o & STOP_BIT) != 0))
+            n_adv = n_adv + take.astype(jnp.int32)
+            run_cols.append(take)
+            vid_cols.append(vid_o)
+            run_prev = take
+        exec_new = state.exec_slot + n_adv
+        run = jnp.stack(run_cols, axis=1)                 # [G, W] bool
+        d_vid_at = jnp.stack(vid_cols, axis=1)            # [G, W]
+        stopped = jnp.maximum(
+            state.stopped, stop_seen.astype(jnp.int32)
+        )
 
     # Majority-rank execute frontier: the slot that >= majority of replicas
     # have executed past (the medianCheckpointedSlot GC watermark analog,
     # PValuePacket.medianCheckpointedSlot / nodeSlotNumbers piggybacking).
     # k-th largest via O(R^2) rank count (no sort/gather): v is the maj-th
     # largest iff #{rows >= v} >= maj, and the largest such v is exact.
-    ge = jnp.where(live, g.exec_slot, NULL)
-    rank = (ge[:, None, :] <= ge[None, :, :]).sum(axis=1)  # [R, G]
-    maj_exec = jnp.where(rank >= maj[None, :], ge, NULL).max(axis=0)
-    maj_exec = jnp.maximum(maj_exec, jnp.int32(0))
+    with jax.named_scope("gp.maj_frontier"):
+        ge = jnp.where(live, g.exec_slot, NULL)
+        rank = (ge[:, None, :] <= ge[None, :, :]).sum(axis=1)  # [R, G]
+        maj_exec = jnp.where(rank >= maj[None, :], ge, NULL).max(axis=0)
+        maj_exec = jnp.maximum(maj_exec, jnp.int32(0))
 
     # ---- 5. coordinator ----
     me_coord = state.c_bal
@@ -622,9 +647,10 @@ def step(
         co_vid = jnp.where(better, a_vid, co_vid)
         return co_slot, co_bal, co_vid
 
-    co_slot, co_bal, co_vid = lax.fori_loop(
-        0, R, fold_carryover, (nullw, nullw, nullw)
-    )
+    with jax.named_scope("gp.carryover"):
+        co_slot, co_bal, co_vid = lax.fori_loop(
+            0, R, fold_carryover, (nullw, nullw, nullw)
+        )
     my_ok = (acc_slot != NULL) & (acc_slot >= exec2)
     mine = my_ok & ((acc_slot > co_slot) | ((acc_slot == co_slot) & (acc_bal > co_bal)))
     co_slot = jnp.where(mine, acc_slot, co_slot)
@@ -701,27 +727,36 @@ def step(
     # unroll with [G, W] temporaries; consecutive candidates map to
     # DISTINCT lanes (K <= W enforced above), so the sequential placement
     # equals the reference's all-at-once one-hot scatter.
-    c_next = jnp.where(is_active, jnp.maximum(c_next, exec_new), c_next)
-    bound = maj_exec + W
-    adm_prev = jnp.ones((G,), bool)
-    n_admit = jnp.zeros((G,), jnp.int32)
-    for k in range(K):  # static unroll; K small
-        cand_slot = c_next + k                            # [G]
-        oh = lane_of(cand_slot)[:, None] == lanes[None, :]  # [G, W]
-        lane_busy = (oh & (c_prop_slot != NULL)).any(axis=1)
-        dec_at_cand = jnp.where(oh, dec_slot, NULL).max(axis=1)
-        can = (
-            may_admit & (no_stop_before[:, k] > 0)
-            & (req_vid[:, k] != NULL) & (cand_slot < bound) & (~lane_busy)
-            & (dec_at_cand != cand_slot)   # never re-propose a decided slot
+    with jax.named_scope("gp.admission"):
+        c_next = jnp.where(
+            is_active, jnp.maximum(c_next, exec_new), c_next
         )
-        adm = adm_prev & can               # contiguous admission prefix
-        place = oh & adm[:, None]
-        c_prop_vid = jnp.where(place, req_vid[:, k][:, None], c_prop_vid)
-        c_prop_slot = jnp.where(place, cand_slot[:, None], c_prop_slot)
-        n_admit = n_admit + adm.astype(jnp.int32)
-        adm_prev = adm
-    c_next = c_next + n_admit
+        bound = maj_exec + W
+        adm_prev = jnp.ones((G,), bool)
+        n_admit = jnp.zeros((G,), jnp.int32)
+        for k in range(K):  # static unroll; K small
+            cand_slot = c_next + k                        # [G]
+            oh = lane_of(cand_slot)[:, None] == lanes[None, :]  # [G, W]
+            lane_busy = (oh & (c_prop_slot != NULL)).any(axis=1)
+            dec_at_cand = jnp.where(oh, dec_slot, NULL).max(axis=1)
+            can = (
+                may_admit & (no_stop_before[:, k] > 0)
+                & (req_vid[:, k] != NULL) & (cand_slot < bound)
+                & (~lane_busy)
+                & (dec_at_cand != cand_slot)  # never re-propose a
+                                              # decided slot
+            )
+            adm = adm_prev & can           # contiguous admission prefix
+            place = oh & adm[:, None]
+            c_prop_vid = jnp.where(
+                place, req_vid[:, k][:, None], c_prop_vid
+            )
+            c_prop_slot = jnp.where(
+                place, cand_slot[:, None], c_prop_slot
+            )
+            n_admit = n_admit + adm.astype(jnp.int32)
+            adm_prev = adm
+        c_next = c_next + n_admit
 
     new_state = EngineState(
         member_mask=state.member_mask, majority=state.majority,

@@ -221,84 +221,139 @@ def _build_stacked(cfg: EngineConfig, mesh: Optional[Mesh], n_steps: int,
 
 
 def _build_packed(cfg: EngineConfig, mesh: Optional[Mesh], n_steps: int,
-                  donate: bool):
+                  donate: bool, heat: bool):
     R = cfg.n_replicas
     M = out_vec_len(cfg)
 
     def _pack_out(out):
         return jnp.concatenate([jnp.ravel(leaf) for leaf in out])
 
+    # ONE traced core for both the plain and the heat-carrying entry:
+    # the core always folds the [G] activity accumulator (decisions +
+    # admissions per group, per substep); the plain entry simply drops
+    # that output, and XLA's dead-code elimination strips the adds, so
+    # heat=False still compiles the exact legacy program.
     if n_steps == 1:
         # the exact legacy step_host program (plus a trivial [1, M]
         # reshape): one upload, one step, two downloads
-        @partial(jax.jit, donate_argnums=(0,) if donate else ())
-        def run(state, gvec, heard, req_ring, want_coord, my_id):
+        def _core(state, gvec, heard, req_ring, want_coord, my_id,
+                  heat_acc):
             state = _constrain(mesh, state, GROUP_AXIS)
             g = unpack_gathered(gvec, cfg)
             new_state, out = step(
                 state, g, heard, req_ring[0], want_coord, my_id, cfg=cfg
             )
+            heat_acc = _constrain(
+                mesh, heat_acc + out.n_committed + out.n_admitted,
+                GROUP_AXIS,
+            )
             out_rings = _pack_out(out)[None]
             blob_vec = pack_blob(make_blob(new_state))
             return (
                 _constrain(mesh, new_state, GROUP_AXIS),
-                out_rings, blob_vec,
+                out_rings, blob_vec, heat_acc,
+            )
+    else:
+        def _core(state, gvec, heard, req_ring, want_coord, my_id,
+                  heat_acc):
+            state = _constrain(mesh, state, GROUP_AXIS)
+            heat_acc = _constrain(mesh, heat_acc, GROUP_AXIS)
+            gathered0 = unpack_gathered(gvec, cfg)
+            out0 = jnp.zeros((n_steps, M), jnp.int32)
+
+            def body(i, carry):
+                st, outs, ht = carry
+                # substeps >= 1 refresh MY gathered row from the
+                # advancing state; peers' rows stay frozen for the whole
+                # dispatch — exactly N serial ticks during which no peer
+                # frame lands.  Substep 0 consumes gvec verbatim
+                # (bit-parity with N=1 even when the caller's self row
+                # is stale).
+                g = jax.tree.map(
+                    lambda gl, bl: jnp.where(
+                        i > 0, gl.at[my_id].set(bl), gl
+                    ),
+                    gathered0, make_blob(st),
+                )
+                req_i = lax.dynamic_index_in_dim(
+                    req_ring, i, axis=0, keepdims=False
+                )
+                want_i = want_coord & (i == 0)
+                st, out = step(st, g, heard, req_i, want_i, my_id,
+                               cfg=cfg)
+                outs = lax.dynamic_update_index_in_dim(
+                    outs, _pack_out(out), i, axis=0
+                )
+                ht = ht + out.n_committed + out.n_admitted
+                return st, outs, ht
+
+            new_state, out_rings, heat_acc = lax.fori_loop(
+                0, n_steps, body, (state, out0, heat_acc)
+            )
+            blob_vec = pack_blob(make_blob(new_state))
+            return (
+                _constrain(mesh, new_state, GROUP_AXIS), out_rings,
+                blob_vec, _constrain(mesh, heat_acc, GROUP_AXIS),
             )
 
-        return run
+    if heat:
+        # heat-carrying face: the accumulator rides the dispatch like a
+        # state leaf (donated alongside it) and is pulled host-side only
+        # at the stats cadence — never per tick
+        @partial(jax.jit, donate_argnums=(0, 6) if donate else ())
+        def run_heat(state, gvec, heard, req_ring, want_coord, my_id,
+                     heat_acc):
+            return _core(state, gvec, heard, req_ring, want_coord,
+                         my_id, heat_acc)
+
+        return run_heat
 
     @partial(jax.jit, donate_argnums=(0,) if donate else ())
-    def run_n(state, gvec, heard, req_ring, want_coord, my_id):
-        state = _constrain(mesh, state, GROUP_AXIS)
-        gathered0 = unpack_gathered(gvec, cfg)
-        out0 = jnp.zeros((n_steps, M), jnp.int32)
-
-        def body(i, carry):
-            st, outs = carry
-            # substeps >= 1 refresh MY gathered row from the advancing
-            # state; peers' rows stay frozen for the whole dispatch —
-            # exactly N serial ticks during which no peer frame lands.
-            # Substep 0 consumes gvec verbatim (bit-parity with N=1
-            # even when the caller's self row is stale).
-            g = jax.tree.map(
-                lambda gl, bl: jnp.where(i > 0, gl.at[my_id].set(bl), gl),
-                gathered0, make_blob(st),
-            )
-            req_i = lax.dynamic_index_in_dim(
-                req_ring, i, axis=0, keepdims=False
-            )
-            want_i = want_coord & (i == 0)
-            st, out = step(st, g, heard, req_i, want_i, my_id, cfg=cfg)
-            outs = lax.dynamic_update_index_in_dim(
-                outs, _pack_out(out), i, axis=0
-            )
-            return st, outs
-
-        new_state, out_rings = lax.fori_loop(
-            0, n_steps, body, (state, out0)
+    def run(state, gvec, heard, req_ring, want_coord, my_id):
+        new_state, out_rings, blob_vec, _ = _core(
+            state, gvec, heard, req_ring, want_coord, my_id,
+            jnp.zeros((cfg.n_groups,), jnp.int32),
         )
-        blob_vec = pack_blob(make_blob(new_state))
-        return (
-            _constrain(mesh, new_state, GROUP_AXIS), out_rings, blob_vec,
-        )
+        return new_state, out_rings, blob_vec
 
-    return run_n
+    return run
 
 
 @functools.lru_cache(maxsize=None)
-def _make_step_cached(cfg, mesh, steps_per_dispatch, donate, io):
+def _make_step_cached(cfg, mesh, steps_per_dispatch, donate, io, heat):
+    from ..obs.device import StepSentinel
+
     if steps_per_dispatch < 1:
         raise ValueError("steps_per_dispatch must be >= 1")
     if io == "stacked":
-        return _build_stacked(cfg, mesh, steps_per_dispatch, donate)
-    if io == "packed_host":
-        return _build_packed(cfg, mesh, steps_per_dispatch, donate)
-    raise ValueError(f"unknown io flavor: {io!r}")
+        if heat:
+            raise ValueError(
+                "heat accumulation is a packed_host feature (the "
+                "stacked/SPMD face reads StepOutputs directly)"
+            )
+        fn = _build_stacked(cfg, mesh, steps_per_dispatch, donate)
+    elif io == "packed_host":
+        fn = _build_packed(cfg, mesh, steps_per_dispatch, donate, heat)
+    else:
+        raise ValueError(f"unknown io flavor: {io!r}")
+    # every factory instance leaves through the retrace/compile sentinel
+    # (obs/device.py): each XLA compile is recorded, and a recompile
+    # after warmup is surfaced as engine_retraces instead of vanishing
+    # into a silently 100x-slower tick
+    mesh_tag = "x".join(
+        f"{k}{v}" for k, v in mesh.shape.items()
+    ) if mesh is not None else "none"
+    label = (
+        f"make_step[{io} N={steps_per_dispatch} donate={donate} "
+        f"heat={heat} mesh={mesh_tag} G={cfg.n_groups} "
+        f"R={cfg.n_replicas} W={cfg.window} K={cfg.req_lanes}]"
+    )
+    return StepSentinel(fn, label=label)
 
 
 def make_step(cfg: EngineConfig, mesh: Optional[Mesh] = None,
               steps_per_dispatch: int = 1, *, donate: bool = True,
-              io: str = "stacked"):
+              io: str = "stacked", heat: bool = False):
     """Build THE consensus step: mesh-parameterized, N-steps-resident.
 
     Parameters
@@ -318,12 +373,23 @@ def make_step(cfg: EngineConfig, mesh: Optional[Mesh] = None,
     io : ``"stacked"`` ([R, ...] SPMD/bench face) or ``"packed_host"``
         (one replica + packed [R, NB] gathered vectors — the deployed
         runtime's face; see the module docstring for signatures).
+    heat : (``packed_host`` only) carry a donated ``[G]`` int32
+        activity accumulator through the dispatch — the step takes it
+        as a trailing argument and returns ``heat + n_committed +
+        n_admitted`` folded across every substep inside the device
+        loop.  The host pulls it at the STATS cadence (obs/device.py
+        heat analysis), never per tick.  ``False`` keeps the exact
+        legacy signatures.
 
-    Instances are memoized: the same (cfg, mesh, N, donate, io) returns
-    the same callable, so jit caches are shared across managers.
+    Instances are memoized: the same (cfg, mesh, N, donate, io, heat)
+    returns the same callable, so jit caches are shared across
+    managers.  Every instance is wrapped in a
+    :class:`gigapaxos_tpu.obs.device.StepSentinel`, so compiles and
+    retraces are recorded process-wide.
     """
     return _make_step_cached(
-        cfg, mesh, int(steps_per_dispatch), bool(donate), str(io)
+        cfg, mesh, int(steps_per_dispatch), bool(donate), str(io),
+        bool(heat),
     )
 
 

@@ -112,6 +112,26 @@ class PC(FlagEnum):
     # this count are rotated out, so repeated local soak runs stop
     # accumulating unbounded JSON in the repo root (0 disables rotation)
     FLIGHT_MAX_DUMPS = 64
+    # device-plane observatory (obs/device.py): where the `profile`
+    # admin op drops jax.profiler captures, how many capture dirs are
+    # kept (flight-recorder-style rotation), and the per-capture wall
+    # cap (the op runs synchronously on a transport thread)
+    ENGINE_PROFILE_DIR = "engine_profiles"
+    ENGINE_PROFILE_MAX_DUMPS = 8
+    ENGINE_PROFILE_MAX_S = 5.0
+    # group-heat telemetry: rows listed in the `stats` op's
+    # engine.heat.top_groups block (the on-device [G] accumulator is
+    # always on; this only sizes the human-readable table)
+    GROUP_HEAT_TOPK = 8
+    # per-phase latency budgets for `scripts/gp_trace.py --slo`
+    # (phase=milliseconds, comma-separated; phases are the merged-trace
+    # labels of obs/tracemerge.py plus the pseudo-phase `total`).
+    # Soak triage: a merged trace whose phase total exceeds its budget
+    # flags the trace and the script exits non-zero.
+    SLO_BUDGETS_MS = (
+        "ingress=50,consensus=500,execute-gate=250,flush=100,"
+        "client-wire=250,total=2000"
+    )
 
     # ---- transactions (txn/: sorted 2PC-over-Paxos) --------------------
     # driver budget from begin to all-prepared, and the resolver's

@@ -172,6 +172,8 @@ class PaxosServer:
         # deployment stays silent and pays one level check per period
         self._stats_period_s = Config.get_float(PC.STATS_LOG_PERIOD_S)
         self._last_stats_line = time.monotonic()
+        # host_dispatches total at the last stats line (rate numerator)
+        self._last_stats_dispatches = 0.0
         self._chunk_lock = threading.Lock()
         # (sender, xfer id) -> {"n": total, "parts": {i: bytes}, "t": time}
         self._chunk_rx: Dict[Tuple[int, str], Dict] = {}
@@ -646,10 +648,16 @@ class PaxosServer:
                 },
                 # engine counters + the mesh actually backing the state
                 # arrays (n_devices/shape/platform): an accidentally
-                # unsharded deployment is a stats read away, not an OOM
+                # unsharded deployment is a stats read away, not an OOM.
+                # `compile` is the retrace-sentinel block (obs/device.py)
+                # and `heat` the per-group activity skew — the stats op
+                # is operator-initiated, so it may pull the device-side
+                # heat accumulator (stats cadence, not hot path)
                 "engine": {
                     **self.manager.metrics.snapshot(),
                     "mesh": self.manager.mesh_info(),
+                    "compile": self.manager.engine_compile_stats(),
+                    "heat": self._heat_stats(),
                 },
                 "profiler": DelayProfiler.get_snapshot(),
                 "profiler_line": DelayProfiler.get_stats(),
@@ -683,6 +691,37 @@ class PaxosServer:
                     limit=int(body.get("limit", 256)),
                 ),
             }))
+        elif op == "profile":
+            # on-demand jax.profiler capture of whatever this node is
+            # doing right now (tick loop keeps running in its thread),
+            # into a bounded dump dir — the device-plane flightdump.
+            # Synchronous by design: the capture window is clamped to
+            # ENGINE_PROFILE_MAX_S so the transport thread is parked for
+            # a bounded, operator-chosen moment
+            from .obs.device import ProfileBusy, capture_profile
+
+            out_dir = str(
+                body.get("dir")
+                or Config.get_str(PC.ENGINE_PROFILE_DIR)
+                or "engine_profiles"
+            )
+            try:
+                cap = capture_profile(
+                    out_dir,
+                    seconds=float(body.get("seconds", 0.25)),
+                    max_dumps=Config.get_int(PC.ENGINE_PROFILE_MAX_DUMPS),
+                    max_seconds=Config.get_float(PC.ENGINE_PROFILE_MAX_S),
+                )
+                self.manager.metrics.count("engine_profile_captures")
+                reply(encode_json("admin_response", self.my_id, {
+                    "op": op, "name": body.get("name"), "ok": True,
+                    "node": self.my_id, **cap,
+                }))
+            except ProfileBusy:
+                reply(encode_json("admin_response", self.my_id, {
+                    "op": op, "name": body.get("name"), "ok": False,
+                    "node": self.my_id, "error": "profile_busy",
+                }))
         elif op == "flightdump":
             # the black box, on demand: dump the engine-history rings to
             # disk and answer with the path (plus ring occupancy, so an
@@ -958,11 +997,21 @@ class PaxosServer:
             else:
                 self.send_frame_to_id(dst, frame)
 
+    def _heat_stats(self) -> Dict:
+        """Group-heat block for the ``stats`` op — degrades to an empty
+        dict rather than failing the admin plane."""
+        try:
+            self.manager.pull_group_heat()
+            return self.manager.group_heat_stats()
+        except Exception:
+            return {}
+
     def _maybe_stats_line(self) -> None:
         """Periodic INFO stats line (engine counters + DelayProfiler) —
         one `isEnabledFor` check per period when INFO is off."""
         now = time.monotonic()
-        if now - self._last_stats_line < self._stats_period_s:
+        elapsed = now - self._last_stats_line
+        if elapsed < self._stats_period_s:
             return
         self._last_stats_line = now
         # per-process resource gauges (RSS / fds / GC / threads) refresh
@@ -970,10 +1019,35 @@ class PaxosServer:
         # SERVING_WORKERS parent) become visible on /metrics and the
         # stats op long before the box dies
         collect_process_gauges(self.manager.metrics)
+        # the stats-cadence group-heat pull: drains the device-resident
+        # [G] activity accumulator into the group_heat* metrics — the
+        # ONE sanctioned device sync outside the hot-path _np cache
+        # (scripts/check_obs_hygiene.py polices exactly this)
+        try:
+            self.manager.pull_group_heat()
+        except Exception:
+            pass
         if self.log.isEnabledFor(logging.INFO):
+            # dispatch RATE + compile counts ride the plain-log line so a
+            # retrace storm (or a stalled dispatch loop) is visible in a
+            # soak's tail -f, not just on /metrics
+            mx = self.manager.metrics
+            disp = mx.get("host_dispatches")
+            rate = (disp - self._last_stats_dispatches) / max(
+                elapsed, 1e-9
+            )
+            self._last_stats_dispatches = disp
+            cs = self.manager.engine_compile_stats()
+            n_comp = (
+                cs["dispatch"]["compiles"] + cs["tick"]["compiles"]
+            )
+            n_retr = (
+                cs["dispatch"]["retraces"] + cs["tick"]["retraces"]
+            )
             self.log.info(
-                "stats tick=%d %s %s", self._tick,
-                self.manager.metrics.summary_line(),
+                "stats tick=%d dispatch_rate=%.1f/s engine_compiles=%d "
+                "engine_retraces=%d %s %s", self._tick, rate, n_comp,
+                n_retr, self.manager.metrics.summary_line(),
                 DelayProfiler.get_stats(),
             )
 

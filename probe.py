@@ -25,6 +25,18 @@ import time
 from gigapaxos_tpu.testing.ports import free_ports
 
 
+def _probe_provenance() -> dict:
+    """Provenance stamp for capacity artifacts (obs/device.py): the
+    probe is a HOST-path measurement, so the stamp's platform/versions
+    say which host stack produced the number.  Never fails the probe."""
+    try:
+        from gigapaxos_tpu.obs.device import provenance
+
+        return provenance()
+    except Exception as e:  # noqa: BLE001
+        return {"error": repr(e)}
+
+
 def main() -> int:
     if "--bank-ledger" in sys.argv[1:]:
         # delegate to the bank-ledger transaction workload, passing every
@@ -252,9 +264,15 @@ def main() -> int:
     for nm in names:
         ack = client.create_name(nm, actives=[0, 1, 2], timeout=60)
         assert ack and ack.get("ok"), (nm, ack)
-    # warm the path (first requests compile/settle everything)
+    # warm the path (first requests compile/settle everything) — timed
+    # separately: this window holds the engine-step XLA compiles, and a
+    # compile-time regression must be visible as its own artifact field,
+    # not smeared into the capacity ramp
+    t_warm = time.time()
     for nm in names:
         client.send_request_sync(nm, "warm", timeout=30)
+    warmup_s = time.time() - t_warm
+    print(json.dumps({"warmup_s": round(warmup_s, 2)}), flush=True)
 
     n_injectors = args.clients
     # pre-resolve every name's entry target ONCE (round-robin across the
@@ -453,6 +471,7 @@ def main() -> int:
                         f"resp<{args.threshold} or "
                         f"latency>{args.latency_ms}ms, "
                         f"{max(1, args.repeats)} repeats",
+            "warmup_s": round(warmup_s, 2),
         }
         print(json.dumps(summary), flush=True)
         if args.capacity_out:
@@ -492,6 +511,8 @@ def main() -> int:
                 "curves": [r["rounds"] for r in repeats],
                 "protocol": summary["protocol"],
                 "phases": phases,
+                "warmup_s": summary["warmup_s"],
+                "provenance": _probe_provenance(),
             }
             with open(args.capacity_out, "w") as f:
                 json.dump(doc, f, indent=1, sort_keys=True)

@@ -13,12 +13,12 @@ hatches the logging plane replaced:
 place allowed to own a stream handler.
 
 Second pass (the inventory gate): every metric name registered in code
-(``.count("…")`` / ``.gauge("…")`` / ``.observe("…")`` with a literal or
-f-string first argument) must appear in ``METRICS.md``, and every name
-documented there must exist in code.  Dynamically-labeled series
-(f-strings like ``probe_rtt_ms_active_{id}``) are documented with a
-``*`` wildcard (``probe_rtt_ms_active_*``) and matched by their literal
-prefix.
+(``.count("…")`` / ``.gauge("…")`` / ``.observe("…")`` /
+``.observe_bulk("…")`` with a literal or f-string first argument) must
+appear in ``METRICS.md``, and every name documented there must exist in
+code.  Dynamically-labeled series (f-strings like
+``probe_rtt_ms_active_{id}``) are documented with a ``*`` wildcard
+(``probe_rtt_ms_active_*``) and matched by their literal prefix.
 
 Third pass (the hot-path pull gate): ``_np("leaf")`` device pulls
 inside the tick/dispatch hot path — the functions named in
@@ -28,6 +28,14 @@ once wedged a pinned chaos seed for minutes of wall time (the ballot
 cache exists precisely so the hot path never re-pulls it).  Adding a
 pull to a hot function means consciously widening the allowlist here,
 with the latency argument in the PR.
+
+The same pass gates ``pull_group_heat()`` — the group-heat device pull
+— under the pseudo-leaf ``__group_heat__``.  It drains AND RESETS the
+on-device ``[G]`` accumulator, so a second call site would silently
+halve every heat histogram besides adding a per-tick sync; the one
+sanctioned caller is the server's stats-cadence hook
+(``_maybe_stats_line``), which runs at ``STATS_LOG_PERIOD_S``, not per
+tick.
 
 Run standalone (exit 1 on violations) or through the tier-1 test
 ``tests/test_obs.py::test_obs_hygiene_gate`` so future code stays on
@@ -45,8 +53,13 @@ from typing import Iterator, Set, Tuple
 
 PACKAGE = "gigapaxos_tpu"
 EXEMPT_TOP_DIRS = ("obs",)
-METRIC_METHODS = ("count", "gauge", "observe")
+METRIC_METHODS = ("count", "gauge", "observe", "observe_bulk")
 METRICS_DOC = "METRICS.md"
+
+# Pseudo-leaf for the group-heat accumulator pull: `pull_group_heat()`
+# calls in gated functions are checked against the allowlist under this
+# name (it is a device sync AND a destructive drain — see module doc).
+GROUP_HEAT_LEAF = "__group_heat__"
 
 # The tick/dispatch hot path: every `_np("leaf")` pull these functions
 # are ALLOWED to make.  An empty set means the function must never pull
@@ -67,6 +80,9 @@ HOT_NP_ALLOW = {
     ),
     ("server.py", "_should_tick"): frozenset({"bal", "member_mask"}),
     ("server.py", "_tick_once_inner"): frozenset({"bal", "member_mask"}),
+    # stats-cadence hook: the ONE sanctioned group-heat drain (runs at
+    # STATS_LOG_PERIOD_S inside the tick loop, not per tick)
+    ("server.py", "_maybe_stats_line"): frozenset({GROUP_HEAT_LEAF}),
 }
 
 
@@ -207,6 +223,14 @@ def iter_hot_np_violations(
                 fn = call.func
                 fn_name = fn.attr if isinstance(fn, ast.Attribute) \
                     else getattr(fn, "id", None)
+                if fn_name == "pull_group_heat":
+                    if GROUP_HEAT_LEAF not in allow:
+                        yield (str(rel), call.lineno,
+                               f"pull_group_heat() in hot path "
+                               f"{node.name}() — a device sync AND a "
+                               "destructive accumulator drain; the stats-"
+                               "cadence hook is the one sanctioned caller")
+                    continue
                 if fn_name != "_np":
                     continue
                 arg = call.args[0] if call.args else None

@@ -11,13 +11,22 @@ one merged timeline per request with per-hop latency attribution
 
 Usage:
   python scripts/gp_trace.py --servers 127.0.0.1:3000,127.0.0.1:3001 \\
-      [--rid 123 | --name probe0] [--limit 64] [--json]
+      [--rid 123 | --name probe0] [--limit 64] [--json] \\
+      [--slo [ingress=50,consensus=500,total=2000]]
   python scripts/gp_trace.py --props scenarios/loopback_3ar_3rc.properties
 
 With ``--props`` the server list is the scenario's actives (the same
 address book ``probe.py --attach`` uses).  Requires the cluster to have
 traced something: run clients with ``GP_TRACE_SAMPLE=1`` (or any rate),
 or servers with ``GP_TRACE=1``.
+
+``--slo`` turns the merge into a latency gate: every merged trace's
+per-phase totals (plus the ``total`` pseudo-phase, end-to-end wall
+time) are checked against ``phase=ms`` budgets — given inline, or
+defaulting to the ``SLO_BUDGETS_MS`` flag (so a scenario's properties
+file sets the deployment's budgets).  Breaching traces are printed with
+the offending phases and the script exits 3, so a soak harness can do
+``gp_trace.py --props ... --slo || dump_more``.
 """
 
 import argparse
@@ -58,6 +67,11 @@ def main() -> int:
                     help="newest keys per node without --rid/--name")
     ap.add_argument("--json", action="store_true",
                     help="emit merged traces as JSON instead of text")
+    ap.add_argument("--slo", nargs="?", const="", default=None,
+                    metavar="BUDGETS",
+                    help="flag traces whose phase totals exceed their "
+                         "budgets (phase=ms CSV; bare --slo uses the "
+                         "SLO_BUDGETS_MS flag) and exit 3 on any breach")
     ap.add_argument("--timeout", type=float, default=10.0)
     args = ap.parse_args()
 
@@ -93,10 +107,27 @@ def main() -> int:
               "tracing: set GP_TRACE_SAMPLE / GP_TRACE)", file=sys.stderr)
         return 1
     traces = tracemerge.merge_node_dumps(dumps)
+
+    breached = []
+    if args.slo is not None:
+        try:
+            budgets = tracemerge.default_slo_budgets(args.slo)
+        except ValueError as e:
+            print(f"bad --slo budgets: {e}", file=sys.stderr)
+            return 2
+        for tr in traces:
+            over = tracemerge.slo_breaches(tr, budgets)
+            if over:
+                breached.append((tr, over))
+
     if args.json:
         print(json.dumps({
             "nodes": sorted(dumps),
             "traces": traces,
+            **({"slo_breaches": [
+                {"keys": tr["keys"], "breaches": over}
+                for tr, over in breached
+            ]} if args.slo is not None else {}),
         }, indent=1))
     else:
         if not traces:
@@ -106,6 +137,16 @@ def main() -> int:
         for tr in traces:
             print(tracemerge.render_trace(tr))
             print()
+        for tr, over in breached:
+            print(f"SLO BREACH {tr['keys']}: " + " ".join(
+                f"{b['phase']}={b['dt_s'] * 1e3:.1f}ms"
+                f">{b['budget_s'] * 1e3:g}ms" for b in over
+            ))
+    if breached:
+        if not args.json:
+            print(f"{len(breached)}/{len(traces)} trace(s) over SLO "
+                  "budget", file=sys.stderr)
+        return 3
     return 0
 
 

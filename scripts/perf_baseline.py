@@ -1,0 +1,447 @@
+#!/usr/bin/env python
+"""perf_baseline — fold the committed bench/capacity artifacts into one
+PERF_BASELINE.json trend and gate fresh microbenches against its noise
+bands (the perf-regression observatory).
+
+The committed round artifacts (``BENCH_r*.json``, ``CAPACITY_r*.json``,
+``MULTICHIP_r*.json``) each hold one round's number in that round's
+shape; nothing reads them ACROSS rounds, so a slow regression (each
+round 15% below the last) is invisible until someone eyeballs the
+series.  This script is the cross-round reader:
+
+* extracts every round's headline decisions/s (keyed by PLATFORM — a
+  cpu round and a tpu round differ ~70x and must never share a band),
+  the capacity probe's req/s per label, the dispatch-ablation arms
+  (throughput + host dispatch counts), and the multichip weak-scaling
+  point;
+* derives a noise band per series: ``lower = min(series) * (1 -
+  margin)``.  Margins are deliberately generous and documented per
+  series — probe.py measures ±40% run-to-run on a loaded host, and the
+  committed cpu rounds were driven on multi-core boxes while the gate
+  may run on a 1-core container (measured ~2x spread).  The gate
+  exists to catch the 10x cliffs (an accidental per-dispatch retrace,
+  a host sync added to the hot loop), not 2x host-class differences;
+* computes the engine's state bytes/group at the headline CPU shape
+  from the live code (a structural memory trend: a new ``[G, W]`` state
+  leaf shows up here before it shows up as a TPU OOM);
+* optionally records a FRESH microbench (``--run-fresh`` runs
+  ``bench.py`` on CPU; ``--fresh FILE`` reads one already run) into the
+  artifact with an in/out-of-band verdict, exiting non-zero when the
+  fresh number lands below its platform's band.
+
+Usage:
+  python scripts/perf_baseline.py --run-fresh     # rebuild + gate
+  python scripts/perf_baseline.py --fresh out.json
+  python scripts/perf_baseline.py                 # rebuild only
+  python scripts/perf_baseline.py --check-only    # validate committed
+                                                  # artifact (tier-1)
+
+``--check-only`` never imports jax and never measures: it asserts the
+committed PERF_BASELINE.json still has every required series, sane
+bands, and an in-band fresh check — the tier-1-adjacent smoke (no
+wall-clock gates in tier-1 proper).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# margin (as a fraction of the series minimum) per series family; the
+# WHY lives in the module docstring and in the emitted band blocks
+MARGIN = {
+    "headline_cpu": 0.60,    # cross-host: 1-core gate vs multi-core rounds
+    "headline_tpu": 0.25,    # committed spread 0.02%; tunnel/chip slack
+    "capacity": 0.50,        # probe.py documents ±40% on a loaded host
+    "ablation": 0.60,        # same host-noise regime as headline_cpu
+    "multichip": 0.50,
+    "state_bytes": 0.10,     # structural, not noisy: layout changes only
+}
+
+REQUIRED_SERIES = (
+    "committed_decisions_per_s",
+    "system_capacity_requests_per_s",
+    "dispatch_ablation",
+    "multichip_weak_scaling",
+    "engine_state_bytes_per_group",
+)
+
+
+def _platform_of(unit: str) -> str:
+    """Collapse a bench unit string's platform tag: cpu-fallback IS a
+    cpu measurement (the fallback marker records why, not what)."""
+    m = re.search(r",\s*([a-z-]+)\)\s*$", unit or "")
+    plat = m.group(1) if m else "unknown"
+    return "cpu" if plat.startswith("cpu") else plat
+
+
+def _band(values, margin: float, note: str) -> dict:
+    vals = sorted(float(v) for v in values)
+    median = vals[len(vals) // 2]
+    return {
+        "min": vals[0],
+        "max": vals[-1],
+        "median": median,
+        "observed_spread_pct": round(
+            (vals[-1] - vals[0]) / median * 100.0, 1
+        ) if median else 0.0,
+        "margin_pct": round(margin * 100.0, 1),
+        "lower": round(vals[0] * (1.0 - margin), 1),
+        "note": note,
+    }
+
+
+def _round_tag(path: str) -> str:
+    m = re.search(r"_r(\d+)\.json$", path)
+    return f"r{int(m.group(1)):02d}" if m else os.path.basename(path)
+
+
+def _load(path: str):
+    with open(path) as f:
+        return json.load(f)
+
+
+# ---- series extraction --------------------------------------------------
+
+def _headline_series(root: str) -> dict:
+    """Per-platform decisions/s across every BENCH_r*.json headline
+    round (the driver wraps early rounds as {"parsed": {...}}; later
+    rounds are the bench JSON itself)."""
+    out: dict = {}
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        doc = _load(path)
+        parsed = doc.get("parsed") or doc
+        if parsed.get("metric") != "committed_decisions_per_s":
+            continue
+        plat = _platform_of(parsed.get("unit", ""))
+        s = out.setdefault(plat, {"rounds": [], "values": []})
+        s["rounds"].append(_round_tag(path))
+        s["values"].append(float(parsed["value"]))
+    for plat, s in out.items():
+        margin = MARGIN["headline_tpu" if plat == "tpu" \
+                        else "headline_cpu"]
+        s["band"] = _band(
+            s["values"], margin,
+            "cpu rounds span multi-core driver boxes and 1-core gate "
+            "containers (~2x)" if plat != "tpu" else
+            "committed tpu rounds agree to 0.02%; margin covers chip "
+            "and tunnel variance",
+        )
+    return out
+
+
+def _capacity_series(root: str) -> dict:
+    """Per-label capacity req/s across CAPACITY_r*.json rounds (labels
+    are probe modes: in_process, durable, steps_n8, ...)."""
+    out: dict = {}
+    for path in sorted(glob.glob(os.path.join(root, "CAPACITY_r*.json"))):
+        doc = _load(path)
+        for label, rec in doc.items():
+            if not (isinstance(rec, dict) and "capacity_rps" in rec):
+                continue
+            s = out.setdefault(label, {"rounds": [], "values": []})
+            s["rounds"].append(_round_tag(path))
+            s["values"].append(float(rec["capacity_rps"]))
+    for s in out.values():
+        s["band"] = _band(
+            s["values"], MARGIN["capacity"],
+            "host-path probe; ±40% run-to-run documented in probe.py",
+        )
+    return out
+
+
+def _ablation_series(root: str) -> dict:
+    """Dispatch-residency ablation trend from the BENCH_r*.json rounds
+    whose metric is dispatch_ablation: per-arm throughput, the host
+    dispatch counts, and the two structural ratios."""
+    rounds, n1, n8, disp_ratio, thr_ratio = [], [], [], [], []
+    dispatches = {}
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        doc = _load(path)
+        if doc.get("metric") != "dispatch_ablation":
+            continue
+        rounds.append(_round_tag(path))
+        arms = doc["arms"]
+        n1.append(float(arms["n1"]["decided_per_s"]))
+        n8.append(float(arms["n8"]["decided_per_s"]))
+        disp_ratio.append(float(doc["dispatch_count_ratio"]))
+        thr_ratio.append(float(doc["throughput_ratio_n8_vs_n1"]))
+        dispatches = {
+            "n1": int(arms["n1"]["host_dispatches"]),
+            "n8": int(arms["n8"]["host_dispatches"]),
+        }
+    if not rounds:
+        return {}
+    return {
+        "rounds": rounds,
+        "decided_per_s_n1": {
+            "values": n1,
+            "band": _band(n1, MARGIN["ablation"],
+                          "cpu arm; same host-noise regime as headline"),
+        },
+        "decided_per_s_n8": {
+            "values": n8,
+            "band": _band(n8, MARGIN["ablation"],
+                          "cpu arm; same host-noise regime as headline"),
+        },
+        "host_dispatches": dispatches,
+        # structural invariants, not noisy measurements: N=8 must cut
+        # dispatches ~8x, and residency must never LOSE throughput
+        "dispatch_count_ratio": {
+            "values": disp_ratio, "lower": 7.5,
+            "note": "structural: 8x fewer host dispatches at N=8",
+        },
+        "throughput_ratio_n8_vs_n1": {
+            "values": thr_ratio, "lower": 0.9,
+            "note": "residency must not cost throughput (>=1.0 expected; "
+                    "0.9 allows measurement noise)",
+        },
+    }
+
+
+def _multichip_series(root: str) -> dict:
+    """Weak-scaling trend from the MULTICHIP_r*.json rounds that hold a
+    real curve (early rounds are skipped-stub records)."""
+    rounds, agg, eff = [], [], []
+    top = {}
+    for path in sorted(glob.glob(os.path.join(root, "MULTICHIP_r*.json"))):
+        doc = _load(path)
+        if doc.get("metric") != "multichip_weak_scaling" \
+                or not doc.get("curve"):
+            continue
+        rounds.append(_round_tag(path))
+        pt = doc["curve"][-1]
+        agg.append(float(pt["aggregate_dec_per_s"]))
+        eff.append(float(doc["scaling"]["efficiency_vs_linear"]))
+        top = {"n_devices": pt["n_devices"], "platform": doc["platform"]}
+    if not rounds:
+        return {}
+    return {
+        "rounds": rounds,
+        "at": top,
+        "aggregate_dec_per_s": {
+            "values": agg,
+            "band": _band(agg, MARGIN["multichip"],
+                          "virtual-mesh cpu points; host-noise regime"),
+        },
+        "efficiency_vs_linear": {
+            "values": eff, "lower": 0.5,
+            "note": "structural: zero-collective sharding must stay "
+                    "near-linear; 0.5 is the alarm line",
+        },
+    }
+
+
+def _state_bytes_per_group() -> dict:
+    """Engine state bytes per group at the headline CPU shape, computed
+    from the LIVE code (imports jax; only called at generation time)."""
+    from gigapaxos_tpu.ops.engine import EngineConfig
+    from gigapaxos_tpu.parallel.spmd import build_replica_states
+
+    cfg = EngineConfig(n_groups=256, window=8, req_lanes=4, n_replicas=3)
+    states = build_replica_states(cfg)
+    total = sum(int(leaf.nbytes) for leaf in states)
+    per_group = total / cfg.n_groups
+    return {
+        "shape": {"G": cfg.n_groups, "W": cfg.window, "K": cfg.req_lanes,
+                  "R": cfg.n_replicas},
+        "bytes_per_group": round(per_group, 1),
+        "note": "structural memory trend (per-replica-set state bytes / "
+                "group at W=8 K=4 R=3); a new [G,W] leaf moves this "
+                "before it OOMs a chip",
+        "margin_pct": round(MARGIN["state_bytes"] * 100.0, 1),
+    }
+
+
+# ---- build / check ------------------------------------------------------
+
+def build_baseline(root: str, with_state_bytes: bool = True) -> dict:
+    doc = {
+        "metric": "perf_baseline_trend",
+        "what": "cross-round perf trend + noise bands folded from the "
+                "committed BENCH_r*/CAPACITY_r*/MULTICHIP_r* artifacts; "
+                "regenerate with scripts/perf_baseline.py",
+        "sources": sorted(
+            os.path.basename(p) for pat in
+            ("BENCH_r*.json", "CAPACITY_r*.json", "MULTICHIP_r*.json")
+            for p in glob.glob(os.path.join(root, pat))
+        ),
+        "series": {
+            "committed_decisions_per_s": _headline_series(root),
+            "system_capacity_requests_per_s": _capacity_series(root),
+            "dispatch_ablation": _ablation_series(root),
+            "multichip_weak_scaling": _multichip_series(root),
+        },
+    }
+    if with_state_bytes:
+        doc["series"]["engine_state_bytes_per_group"] = \
+            _state_bytes_per_group()
+    return doc
+
+
+def check_fresh(baseline: dict, fresh: dict) -> dict:
+    """Gate one fresh bench.py headline result against its platform's
+    band; returns the fresh_check block (recorded into the artifact)."""
+    if fresh.get("metric") != "committed_decisions_per_s":
+        raise ValueError(
+            f"fresh result metric {fresh.get('metric')!r} is not a "
+            "headline bench line"
+        )
+    plat = _platform_of(fresh.get("unit", ""))
+    series = baseline["series"]["committed_decisions_per_s"].get(plat)
+    if series is None:
+        raise ValueError(f"no committed series for platform {plat!r}")
+    lower = series["band"]["lower"]
+    value = float(fresh["value"])
+    return {
+        "platform": plat,
+        "value": value,
+        "band_lower": lower,
+        "in_band": value >= lower,
+        "warmup_s": fresh.get("warmup_s"),
+        "provenance": fresh.get("provenance"),
+        "unit": fresh.get("unit"),
+    }
+
+
+def validate(doc: dict) -> list:
+    """Structural check of a committed PERF_BASELINE.json (the tier-1
+    smoke): every required series present and every band sane."""
+    errs = []
+    series = doc.get("series") or {}
+    for name in REQUIRED_SERIES:
+        if not series.get(name):
+            errs.append(f"series {name!r} missing or empty")
+    for plat, s in (series.get("committed_decisions_per_s") or {}).items():
+        band = s.get("band") or {}
+        if not (0 < band.get("lower", 0) <= min(s.get("values") or [0])):
+            errs.append(f"headline[{plat}]: band lower not below series")
+        if len(s.get("rounds", [])) != len(s.get("values", [])):
+            errs.append(f"headline[{plat}]: rounds/values length mismatch")
+    for label, s in (series.get("system_capacity_requests_per_s")
+                     or {}).items():
+        band = s.get("band") or {}
+        if not (0 < band.get("lower", 0) <= min(s.get("values") or [0])):
+            errs.append(f"capacity[{label}]: band lower not below series")
+    fresh = doc.get("fresh_check")
+    if not fresh:
+        errs.append("fresh_check missing (run --run-fresh)")
+    elif not fresh.get("in_band"):
+        errs.append(
+            f"fresh_check out of band: {fresh.get('value')} < "
+            f"{fresh.get('band_lower')} ({fresh.get('platform')})"
+        )
+    return errs
+
+
+def _run_fresh_bench() -> dict:
+    """Run bench.py as a CPU microbench subprocess and parse its one
+    JSON line.  CPU is forced: the gate must be runnable (and mean the
+    same thing) on boxes without a chip, and must not eat a 300s TPU
+    probe timeout per invocation."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=900, env=env, cwd=REPO,
+    )
+    for line in reversed(r.stdout.strip().splitlines()):
+        try:
+            doc = json.loads(line)
+        except ValueError:
+            continue
+        if doc.get("metric") == "committed_decisions_per_s":
+            return doc
+    raise RuntimeError(
+        f"bench.py produced no headline JSON line (rc={r.returncode}): "
+        f"{(r.stderr or r.stdout)[-500:]}"
+    )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default=os.path.join(REPO,
+                                                  "PERF_BASELINE.json"))
+    ap.add_argument("--root", default=REPO,
+                    help="directory holding the round artifacts")
+    ap.add_argument("--check-only", action="store_true",
+                    help="validate the committed artifact; no rebuild, "
+                         "no bench run, no jax import")
+    ap.add_argument("--run-fresh", action="store_true",
+                    help="run bench.py (CPU) and gate + record the "
+                         "result")
+    ap.add_argument("--fresh", metavar="FILE", default=None,
+                    help="gate + record an already-captured bench JSON "
+                         "line ('-' = stdin)")
+    args = ap.parse_args(argv)
+
+    if args.check_only:
+        try:
+            doc = _load(args.out)
+        except (OSError, ValueError) as e:
+            print(f"PERF_BASELINE unreadable: {e}", file=sys.stderr)
+            return 1
+        errs = validate(doc)
+        for e in errs:
+            print(f"PERF_BASELINE: {e}", file=sys.stderr)
+        if errs:
+            return 1
+        print(f"{os.path.basename(args.out)} ok: "
+              f"{len(doc['series'])} series, fresh check in band "
+              f"({doc['fresh_check']['value']:.0f} >= "
+              f"{doc['fresh_check']['band_lower']:.0f} "
+              f"{doc['fresh_check']['platform']})")
+        return 0
+
+    sys.path.insert(0, args.root)
+    doc = build_baseline(args.root)
+
+    fresh = None
+    if args.run_fresh:
+        fresh = _run_fresh_bench()
+    elif args.fresh:
+        raw = sys.stdin.read() if args.fresh == "-" else \
+            open(args.fresh).read()
+        fresh = json.loads(raw)
+    if fresh is not None:
+        doc["fresh_check"] = check_fresh(doc, fresh)
+    else:
+        # keep a previously recorded fresh check across rebuilds: the
+        # bands only move when round artifacts change, and a rebuild
+        # without a measurement must not silently drop the gate record
+        try:
+            prev = _load(args.out)
+            if prev.get("fresh_check"):
+                doc["fresh_check"] = prev["fresh_check"]
+                doc["fresh_check"]["carried_over"] = True
+        except (OSError, ValueError):
+            pass
+
+    tmp = args.out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, args.out)
+
+    fc = doc.get("fresh_check")
+    if fc:
+        verdict = "IN band" if fc["in_band"] else "BELOW band"
+        print(f"fresh {fc['platform']} microbench {fc['value']:.0f} "
+              f"dec/s {verdict} (lower {fc['band_lower']:.0f}); "
+              f"wrote {os.path.basename(args.out)}")
+        if not fc["in_band"]:
+            return 1
+    else:
+        print(f"wrote {os.path.basename(args.out)} (no fresh check)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
