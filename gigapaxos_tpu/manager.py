@@ -68,7 +68,7 @@ from .obs import gplog
 from .obs.flight import FlightRecorder
 from .obs.metrics import MetricsRegistry
 from .obs.reqtrace import RequestTracer
-from .ops.lifecycle import create_groups, kill_groups
+from .ops.lifecycle import create_groups, kill_groups, restore_paused_rows
 from .storage.logger import PaxosLogger
 from .utils.profiler import DelayProfiler
 
@@ -401,14 +401,31 @@ class PaxosManager:
         if log_dir:
             import os as _os
 
-            from .utils.diskmap import DiskMap
+            spill_dir = _os.path.join(log_dir, "paused_spill")
+            cap = Config.get_int(PC.PAUSE_BATCH_SIZE) * 4
+            if Config.get_bool(PC.PACKED_SPILL):
+                from .utils.packedstore import PackedSpillStore
 
-            self.paused = DiskMap(
-                _os.path.join(log_dir, "paused_spill"),
-                capacity=Config.get_int(PC.PAUSE_BATCH_SIZE) * 4,
-            )
+                self.paused = PackedSpillStore(
+                    spill_dir, capacity=cap,
+                    segment_bytes=Config.get_int(PC.SPILL_SEGMENT_BYTES),
+                    compact_ratio=Config.get_float(PC.SPILL_COMPACT_RATIO),
+                    subdirs=Config.get_int(PC.SPILL_SUBDIRS),
+                )
+            else:
+                from .utils.diskmap import DiskMap
+
+                self.paused = DiskMap(spill_dir, capacity=cap)
         else:
             self.paused = {}
+        # name -> {epoch} mirror of self.paused's keys: restore() must
+        # find a hibernated name's epochs without an O(paused) key scan
+        # (the paused table holds the COLD tail — millions of names)
+        self._paused_by_name: Dict[str, set] = {}
+        # name -> wall time of last resume/create activity relevant to
+        # eviction hysteresis (a just-woken name must not be re-paused
+        # by the next sweep even if its traffic burst already ended)
+        self._resumed_at: Dict[str, float] = {}
         self.row_activity = np.zeros(G, np.float64)  # wall time of last use
         # per-name arriving-request counts since the last demand report
         # (updateDemandStats analog; drained by the ActiveReplica layer)
@@ -737,7 +754,7 @@ class PaxosManager:
                             int(rid_s), (float(ent[0]), ent[1], str(ent[2]))
                         )
             elif nm not in self.names:
-                self.paused[(nm, e)] = prec
+                self._paused_put((nm, e), prec)
         # Roll the execute frontier forward through EVERY journaled
         # decision (the rings only hold the last W per group — a group
         # that decided more than W slots since its checkpoint would
@@ -1310,7 +1327,7 @@ class PaxosManager:
             # with a journal tombstone (else the PAUSE block resurrects it
             # on recovery, and a later re-created incarnation of the name
             # could restore the dead incarnation's state)
-            prec = self.paused.pop((name, int(epoch)), None)
+            prec = self._paused_pop((name, int(epoch)))
             if prec is not None:
                 # its shadow queue dies with it: release so retransmits of
                 # those request ids re-propose into the next incarnation
@@ -1350,6 +1367,24 @@ class PaxosManager:
     # PaxosManager.java:2264-2392,2786-2881 — RC-coordinated here because
     # rows must stay aligned across replicas for the blob exchange)
     # ------------------------------------------------------------------
+    def _paused_put(self, key: Tuple[str, int], rec: Dict) -> None:
+        """Insert a pause record, keeping the by-name epoch mirror in
+        sync (every ``self.paused`` mutation goes through _paused_put /
+        _paused_pop — restore() resolves a name's epochs through the
+        mirror instead of scanning millions of cold keys)."""
+        self.paused[key] = rec
+        self._paused_by_name.setdefault(key[0], set()).add(int(key[1]))
+
+    def _paused_pop(self, key: Tuple[str, int]) -> Optional[Dict]:
+        rec = self.paused.pop(key, None)
+        if rec is not None:
+            eps = self._paused_by_name.get(key[0])
+            if eps is not None:
+                eps.discard(int(key[1]))
+                if not eps:
+                    del self._paused_by_name[key[0]]
+        return rec
+
     def pause_group(self, name: str, epoch: int, force: bool = False) -> str:
         """Free (name, epoch)'s row, snapshotting its state to the journal
         and `self.paused`.  Returns "ok", "unknown" (not hosted here — an
@@ -1396,21 +1431,34 @@ class PaxosManager:
                 }
             if self.logger:
                 self.logger.log_pause(rec)
-            self.paused[(name, int(epoch))] = rec
+            self._paused_put((name, int(epoch)), rec)
             self._kill_locked(name, release_queue=False)
+            if not force:
+                # a non-forced pause is the sweeper's capacity eviction
+                # (forced ones are re-homes/hibernates, not evictions)
+                self.metrics.count("pause_evictions")
             return "ok"
 
-    def _extract_record(self, name: str, epoch: int, row: int) -> Dict:
-        """Snapshot one row for pause/re-home (HotRestoreInfo analog)."""
-        s = self.state
-        exec_now = int(np.asarray(s.exec_slot)[row])
+    def _extract_record(
+        self, name: str, epoch: int, row: int,
+        dedup: Optional[Dict] = None,
+    ) -> Dict:
+        """Snapshot one row for pause/re-home (HotRestoreInfo analog).
+        Reads go through the ``_np`` leaf cache — one host transfer per
+        leaf per state version, not per paused name (the old per-call
+        ``np.asarray(leaf)`` copied whole [G, W] planes per pause; a
+        density sweep pays extraction thousands of times per state).
+        ``dedup`` lets a batch caller supply this name's exactly-once
+        entries from ONE grouped response-cache pass instead of the
+        per-name O(cache) scan of :meth:`dedup_for_name`."""
+        exec_now = int(self._np("exec_slot")[row])
         acc = []
         dec = []
-        acc_slot = np.asarray(s.acc_slot)[row]
-        acc_bal = np.asarray(s.acc_bal)[row]
-        acc_vid = np.asarray(s.acc_vid)[row]
-        dec_slot = np.asarray(s.dec_slot)[row]
-        dec_vid = np.asarray(s.dec_vid)[row]
+        acc_slot = self._np("acc_slot")[row]
+        acc_bal = self._np("acc_bal")[row]
+        acc_vid = self._np("acc_vid")[row]
+        dec_slot = self._np("dec_slot")[row]
+        dec_vid = self._np("dec_vid")[row]
         for lane in range(self.cfg.window):
             if int(acc_slot[lane]) >= exec_now:
                 acc.append([int(acc_slot[lane]), int(acc_bal[lane]),
@@ -1420,13 +1468,13 @@ class PaxosManager:
         return {
             "name": name, "epoch": epoch,
             "exec": exec_now,
-            "bal": int(np.asarray(s.bal)[row]),
-            "app_hash": int(np.asarray(s.app_hash)[row]),
-            "n_execd": int(np.asarray(s.n_execd)[row]),
+            "bal": int(self._np("bal")[row]),
+            "app_hash": int(self._np("app_hash")[row]),
+            "n_execd": int(self._np("n_execd")[row]),
             "app_state": self.app.checkpoint(name),
             "app_exec": int(self.app_exec_slot[row]),
             "acc": acc, "dec": dec,
-            "dedup": self.dedup_for_name(name),
+            "dedup": self.dedup_for_name(name) if dedup is None else dedup,
             # member set rides along so a LOCAL restore (hibernate wake-up)
             # needs no reconfigurator round to learn the group
             "members": self.get_replica_group(name),
@@ -1466,10 +1514,10 @@ class PaxosManager:
                     # row, fall through to restore with the new set
                     if self.pause_group(name, epoch, force=True) != "ok":
                         return False
-            rec = self.paused.pop((name, epoch), None)
+            rec = self._paused_pop((name, epoch))
             if int(row) in self.row_name:
                 if rec is not None:
-                    self.paused[(name, epoch)] = rec  # keep for next probe
+                    self._paused_put((name, epoch), rec)  # keep for next probe
                 raise RuntimeError(
                     f"row {row} already hosts {self.row_name[int(row)]!r}"
                 )
@@ -1499,103 +1547,282 @@ class PaxosManager:
                     # adopt a donor's state even at equal frontiers
                     self._needs_state.add(int(row))
                 return ok
+            t0 = time.monotonic()
             ok = self._create_locked(
                 name, members, rec.get("app_state"), epoch, int(row), pending
             )
             if not ok:
-                self.paused[(name, epoch)] = rec
+                self._paused_put((name, epoch), rec)
                 return False
             r = int(row)
-            arrays = {
-                k: np.asarray(v).copy()
-                for k, v in self.state._asdict().items()
-            }
-            arrays["exec_slot"][r] = int(rec["exec"])
-            arrays["bal"][r] = max(int(arrays["bal"][r]), int(rec["bal"]))
-            arrays["app_hash"][r] = int(rec["app_hash"])
-            arrays["n_execd"][r] = int(rec["n_execd"])
-            arrays["c_next_slot"][r] = int(rec["exec"])
-            for slot, b, vid in rec.get("acc") or []:
-                lane = slot % self.cfg.window
-                arrays["acc_slot"][r, lane] = slot
-                arrays["acc_bal"][r, lane] = b
-                arrays["acc_vid"][r, lane] = vid
-            for slot, vid in rec.get("dec") or []:
-                lane = slot % self.cfg.window
-                arrays["dec_slot"][r, lane] = slot
-                arrays["dec_vid"][r, lane] = vid
-            self.state = EngineState(
-                **{k: jnp.asarray(v) for k, v in arrays.items()}
+            # device install + host bookkeeping via the SAME helpers the
+            # batch path uses: resume_group IS resume_group_batch at N=1
+            # (bit-exact parity is pinned by tests/test_batched_unpause)
+            self._install_records_device_locked([(r, rec)])
+            self._resume_record_host_locked(r, rec, name, epoch)
+            self.metrics.observe(
+                "unpause_latency_s", time.monotonic() - t0
             )
-            self.app_exec_slot[r] = int(rec.get("app_exec", rec["exec"]))
-            self._app_exec_dirty.add(r)
-            if int(self.app_exec_slot[r]) < int(rec["exec"]):
-                # a FORCED pause snapshots non-quiescent rows, so the
-                # record can carry app_exec < exec — but the decided
-                # slots in between are in NEITHER the record (dec
-                # remnants keep only >= exec) nor pending_exec (dropped
-                # with the pause).  The cursor can never replay its way
-                # forward, and the gap may sit under jump_horizon with
-                # nothing payload-blocked, so no heal detector fires
-                # (txn-soak find: a hibernated-mid-traffic member woke
-                # with app_exec 24 slots behind a current device
-                # frontier and stayed there forever).  Park the row as
-                # needing donor state — the per-tick state pull + the
-                # app_only adoption clause close the gap
-                self._needs_state.add(r)
-            # same reasoning as the rejoin purge above: the resume ROLLS
-            # BACK to the snapshot, so this member's own response-cache
-            # entries for executions AFTER the snapshot describe state
-            # the restored app does not contain — kept, they would
-            # skip-execute those decisions during catch-up and diverge
-            # the RSM (txn-soak find: a forced mid-traffic hibernate on
-            # one member, woken as a straggler, came back short one
-            # committed transfer).  The snapshot's own paired dedup
-            # reinstalls right below.
-            for rid in [
-                r2 for r2, (_t, _resp, nm) in self.response_cache.items()
-                if nm == name
-            ]:
-                del self.response_cache[rid]
-            self.install_dedup(rec.get("dedup"))
-            # the _create_locked journal entry has the app state as init;
-            # the consensus remnants need the pause record on replay too
-            if self.logger:
-                self.logger.log_pause(rec)
-            held = rec.get("held_vids") or []
-            if held:
-                self.queues[r] = [v for v in held if v in self.arena]
-                scopes = rec.get("held_scopes") or {}
-                for v in self.queues[r]:
-                    sc = scopes.get(str(v))
-                    # pre-scope records default to the resumed instance's
-                    # own scope (they were queued on its row)
-                    self.vid_scope[v] = (
-                        (str(sc[0]), int(sc[1])) if sc else (name, int(epoch))
-                    )
-            # release ORPHANED vids: a proposal admitted from the queue
-            # into the device ring before a FORCED pause is in neither
-            # the held queue nor the record's window remnants — the
-            # consensus copy is gone, but its scheduling state survived
-            # the pause (release_queue=False).  Kept, the stale
-            # inflight entry parks every retransmit of that request id
-            # here AND poisons forward-dedup of fresh peer proposals
-            # for the same id, wedging the group on it forever
-            # (txn-soak find: a resolver's commit re-drive starved
-            # through 4k+ retransmits).  Undecided-only: remnant and
-            # retained (decided) vids keep their state
-            # re-homed/preempted vids can sit in OTHER rows' queues —
-            # anything still queued anywhere is live, not orphaned
-            kept = {v for q in self.queues.values() for v in q}
-            kept.update(v for _s, _b, v in (rec.get("acc") or []))
-            kept.update(v for _s, v in (rec.get("dec") or []))
-            for v in [
-                v for v, (nm, _ep) in self.vid_scope.items()
-                if nm == name and v not in kept and v not in self.retained
-            ]:
-                self._release_vid(v)
-            self.row_activity[r] = time.time()
             return True
+
+    def _install_records_device_locked(
+        self, batch: List[Tuple[int, Dict]]
+    ) -> None:
+        """Scatter N pause records' consensus remnants into rows JUST
+        created by ``create_groups`` — ONE fused device update (one
+        ``.at[rows].set`` per touched leaf) regardless of N.  The old
+        per-name install round-tripped the WHOLE state through host
+        numpy per resumed name; a 4096-name wake burst paid that 4096
+        times."""
+        n = len(batch)
+        W = self.cfg.window
+        rows = np.empty(n, np.int32)
+        exec_ = np.empty(n, np.int32)
+        bal = np.empty(n, np.int32)
+        app_hash = np.empty(n, np.int32)
+        n_execd = np.empty(n, np.int32)
+        acc_bal = np.full((n, W), NULL, np.int32)
+        acc_vid = np.full((n, W), NULL, np.int32)
+        acc_slot = np.full((n, W), NULL, np.int32)
+        dec_vid = np.full((n, W), NULL, np.int32)
+        dec_slot = np.full((n, W), NULL, np.int32)
+        for i, (r, rec) in enumerate(batch):
+            rows[i] = r
+            exec_[i] = int(rec["exec"])
+            # the row's device ballot is the implicit initial (0, coord0)
+            # from the create, mirrored host-side in _bal_host — the max
+            # is computable without a device read
+            bal[i] = max(int(self._bal_host[r]), int(rec["bal"]))
+            app_hash[i] = int(rec["app_hash"])
+            n_execd[i] = int(rec["n_execd"])
+            for slot, b, vid in rec.get("acc") or []:
+                lane = slot % W
+                acc_slot[i, lane] = slot
+                acc_bal[i, lane] = b
+                acc_vid[i, lane] = vid
+            for slot, vid in rec.get("dec") or []:
+                lane = slot % W
+                dec_slot[i, lane] = slot
+                dec_vid[i, lane] = vid
+        self.state = restore_paused_rows(
+            self.state, rows, exec_, bal, app_hash, n_execd,
+            acc_bal, acc_vid, acc_slot, dec_vid, dec_slot,
+        )
+
+    def _resume_record_host_locked(
+        self, r: int, rec: Dict, name: str, epoch: int
+    ) -> None:
+        """Per-name host bookkeeping of a record restore (everything in
+        the resume besides the device scatter).  Shared verbatim by the
+        per-name and batched paths; item order in a batch matches the
+        equivalent sequence of per-name resumes."""
+        self.app_exec_slot[r] = int(rec.get("app_exec", rec["exec"]))
+        self._app_exec_dirty.add(r)
+        if int(self.app_exec_slot[r]) < int(rec["exec"]):
+            # a FORCED pause snapshots non-quiescent rows, so the
+            # record can carry app_exec < exec — but the decided
+            # slots in between are in NEITHER the record (dec
+            # remnants keep only >= exec) nor pending_exec (dropped
+            # with the pause).  The cursor can never replay its way
+            # forward, and the gap may sit under jump_horizon with
+            # nothing payload-blocked, so no heal detector fires
+            # (txn-soak find: a hibernated-mid-traffic member woke
+            # with app_exec 24 slots behind a current device
+            # frontier and stayed there forever).  Park the row as
+            # needing donor state — the per-tick state pull + the
+            # app_only adoption clause close the gap
+            self._needs_state.add(r)
+        # the resume ROLLS BACK to the snapshot, so this member's own
+        # response-cache entries for executions AFTER the snapshot
+        # describe state the restored app does not contain — kept, they
+        # would skip-execute those decisions during catch-up and diverge
+        # the RSM (txn-soak find: a forced mid-traffic hibernate on
+        # one member, woken as a straggler, came back short one
+        # committed transfer).  The snapshot's own paired dedup
+        # reinstalls right below.
+        for rid in [
+            r2 for r2, (_t, _resp, nm) in self.response_cache.items()
+            if nm == name
+        ]:
+            del self.response_cache[rid]
+        self.install_dedup(rec.get("dedup"))
+        # the _create_locked journal entry has the app state as init;
+        # the consensus remnants need the pause record on replay too
+        if self.logger:
+            self.logger.log_pause(rec)
+        held = rec.get("held_vids") or []
+        if held:
+            self.queues[r] = [v for v in held if v in self.arena]
+            scopes = rec.get("held_scopes") or {}
+            for v in self.queues[r]:
+                sc = scopes.get(str(v))
+                # pre-scope records default to the resumed instance's
+                # own scope (they were queued on its row)
+                self.vid_scope[v] = (
+                    (str(sc[0]), int(sc[1])) if sc else (name, int(epoch))
+                )
+        # release ORPHANED vids: a proposal admitted from the queue
+        # into the device ring before a FORCED pause is in neither
+        # the held queue nor the record's window remnants — the
+        # consensus copy is gone, but its scheduling state survived
+        # the pause (release_queue=False).  Kept, the stale
+        # inflight entry parks every retransmit of that request id
+        # here AND poisons forward-dedup of fresh peer proposals
+        # for the same id, wedging the group on it forever
+        # (txn-soak find: a resolver's commit re-drive starved
+        # through 4k+ retransmits).  Undecided-only: remnant and
+        # retained (decided) vids keep their state
+        # re-homed/preempted vids can sit in OTHER rows' queues —
+        # anything still queued anywhere is live, not orphaned
+        kept = {v for q in self.queues.values() for v in q}
+        kept.update(v for _s, _b, v in (rec.get("acc") or []))
+        kept.update(v for _s, v in (rec.get("dec") or []))
+        for v in [
+            v for v, (nm, _ep) in self.vid_scope.items()
+            if nm == name and v not in kept and v not in self.retained
+        ]:
+            self._release_vid(v)
+        now = time.time()
+        self.row_activity[r] = now
+        # eviction hysteresis: a just-woken name is exempt from the idle
+        # sweep for PAUSE_EVICTION_HYSTERESIS_S even if its wake burst
+        # already ended (pause/resume flap protection)
+        self._resumed_at[name] = now
+
+    def resume_group_batch(
+        self,
+        items: List[Tuple[str, int, List[int], int, bool]],
+    ) -> Dict[str, bool]:
+        """Batched unpause: wake N paused records in ONE fused device
+        update — one ``create_groups`` + one ``restore_paused_rows``
+        (two scatters per touched leaf total) instead of N per-name row
+        installs.  ``items`` is ``[(name, epoch, members, row, pending)]``.
+
+        Only the pure record-restore case batches (name not live here, a
+        local pause record exists, the target row is free and unique
+        within the batch); anything else — live re-home, recordless
+        join, collisions — falls back to the per-name :meth:`resume_group`
+        so the batch is an optimization, never a semantic fork.  Returns
+        ``{name: ok}``."""
+        t0 = time.monotonic()
+        out: Dict[str, bool] = {}
+        n_fast = 0
+        deferred: List[Tuple[str, int, List[int], int, bool]] = []
+        with self._state_lock:
+            self._await_step_locked()
+            fast: List[Tuple[str, int, List[int], int, bool]] = []
+            claimed: set = set()
+            for name, epoch, members, row, pending in items:
+                epoch, row = int(epoch), int(row)
+                members = [int(m) for m in members]
+                if (
+                    name not in self.names
+                    and (name, epoch) in self.paused
+                    and row not in self.row_name
+                    and row not in claimed
+                    and members
+                    and len(members) <= self.max_group_size
+                ):
+                    claimed.add(row)
+                    fast.append((name, epoch, members, row, bool(pending)))
+                else:
+                    deferred.append((name, epoch, members, row, pending))
+            if fast:
+                # fault the spilled records in with sorted sequential
+                # segment reads, not one random read per name
+                if hasattr(self.paused, "restore_batch"):
+                    self.paused.restore_batch(
+                        [(nm, ep) for nm, ep, _m, _r, _p in fast]
+                    )
+                batch: List[Tuple[int, Dict]] = []
+                names_l: List[str] = []
+                rows_l: List[int] = []
+                masks: List[int] = []
+                coords: List[int] = []
+                vers: List[int] = []
+                tags: List[int] = []
+                pendings: List[bool] = []
+                recs: List[Dict] = []
+                metas: List[Tuple[str, int, List[int]]] = []
+                for name, epoch, members, row, pending in fast:
+                    rec = self._paused_pop((name, epoch))
+                    if rec is None:  # vanished (concurrent drop): defer
+                        deferred.append((name, epoch, members, row, pending))
+                        continue
+                    mask = 0
+                    for m in members:
+                        mask |= 1 << m
+                    self.names[name] = row
+                    self.row_name[row] = name
+                    if pending:
+                        self.pending_rows.add(row)
+                    coord0 = members[row % len(members)]
+                    self._bal_host[row] = encode_ballot(0, coord0)
+                    self.app_exec_slot[row] = 0
+                    self._release_row_queue(row)
+                    self.pending_exec.pop(row, None)
+                    for arr in self.peer_app_exec.values():
+                        arr[row] = 0
+                    self._stall_since[row] = -1
+                    self._stall_slot[row] = -1
+                    self.row_activity[row] = time.time()
+                    names_l.append(name)
+                    rows_l.append(row)
+                    masks.append(mask)
+                    coords.append(coord0)
+                    vers.append(epoch)
+                    tags.append(_instance_tag(name, epoch))
+                    pendings.append(bool(pending))
+                    recs.append(rec)
+                    metas.append((name, epoch, members))
+                    batch.append((row, rec))
+                if batch:
+                    rows_np = np.array(rows_l, np.int32)
+                    self.state = create_groups(
+                        self.state, rows_np,
+                        np.array(masks, np.int32),
+                        np.array(coords, np.int32),
+                        my_id=self.my_id,
+                        version=np.array(vers, np.int32),
+                        tag=np.array(tags, np.int32),
+                    )
+                    if self.logger:
+                        self.logger.log_create(
+                            rows_np, np.array(masks, np.int32),
+                            np.array(vers, np.int32),
+                            np.array(coords, np.int32),
+                            names=names_l,
+                            inits=[rec.get("app_state") for rec in recs],
+                            pendings=pendings,
+                        )
+                    for (name, _ep, members), rec in zip(metas, recs):
+                        if self.my_id in members:
+                            self.app.restore(name, rec.get("app_state"))
+                    self._install_records_device_locked(batch)
+                    for (row, rec), (name, epoch, _m) in zip(batch, metas):
+                        self._resume_record_host_locked(
+                            row, rec, name, epoch
+                        )
+                        out[name] = True
+                    n_fast = len(batch)
+        if n_fast:
+            dt = time.monotonic() - t0
+            # every name in the burst became available when the batch
+            # completed: the burst wall time IS each name's wake latency
+            # (deferred items observe inside their per-name resume)
+            self.metrics.observe_bulk(
+                "unpause_latency_s", [dt] * n_fast
+            )
+        # non-fast-path items: the per-name resume outside the batch
+        # (it re-takes the lock; a collision NACK maps to False)
+        for name, epoch, members, row, pending in deferred:
+            try:
+                out[name] = self.resume_group(
+                    name, epoch, members, row, pending
+                )
+            except RuntimeError:
+                out[name] = False
+        return out
 
     # ------------------------------------------------------------------
     # hibernate / restore (checkpoint + sleep on disk; local wake-up —
@@ -1622,6 +1849,129 @@ class PaxosManager:
             self.paused.demote((name, epoch))
         return True
 
+    def hibernate_batch(self, names: List[str]) -> int:
+        """Hibernate MANY names: one batched extract off the cached host
+        leaves, ONE fused ``kill_groups`` scatter, one sequential spill
+        run.  Per-name :meth:`hibernate` costs a device kill dispatch per
+        name — putting a 1M-name cold tail to sleep that way is minutes
+        of pure dispatch overhead (the density campaign's boot path).
+        Forced-pause semantics identical to :meth:`hibernate`: window
+        remnants and held vids ride in the records.  Returns how many
+        names went to sleep."""
+        with self._state_lock:
+            self._await_step_locked()
+            versions = self._np("version")
+            stopped = self._np("stopped")
+            jobs: List[Tuple[str, int, int]] = []
+            for name in names:
+                row = self.names.get(name)
+                if row is None or row in self.hydrating_rows:
+                    continue  # not hosted / snapshot would be blank
+                if int(stopped[row]):
+                    continue  # stopping group: the delete path owns it
+                jobs.append((name, int(versions[row]), row))
+            if not jobs:
+                return 0
+            # ONE grouped pass over the response cache for every job's
+            # dedup entries (the per-name scan is O(cache) each)
+            wanted = {name for name, _e, _r in jobs}
+            dedup_by_name: Dict[str, Dict] = {}
+            for rid, (t, resp, nm) in self.response_cache.items():
+                if nm in wanted:
+                    dedup_by_name.setdefault(nm, {})[str(rid)] = [
+                        t, resp, nm
+                    ]
+            rows_l: List[int] = []
+            keys: List[Tuple[str, int]] = []
+            for name, epoch, row in jobs:
+                rec = self._extract_record(
+                    name, epoch, row, dedup=dedup_by_name.get(name, {})
+                )
+                held = list(self.queues.get(row, []))
+                if held:
+                    rec["held_vids"] = held
+                    rec["held_scopes"] = {
+                        str(v): list(self.vid_scope[v])
+                        for v in held if v in self.vid_scope
+                    }
+                if self.logger:
+                    self.logger.log_pause(rec)
+                self._paused_put((name, epoch), rec)
+                rows_l.append(row)
+                keys.append((name, epoch))
+            rows_np = np.array(rows_l, np.int32)
+            self.state = kill_groups(self.state, rows_np)
+            if self.logger:
+                self.logger.log_kill(rows_np)
+            for name, _epoch, row in jobs:
+                # host side of _kill_locked(release_queue=False), minus
+                # the per-name device op the fused kill replaced
+                self.names.pop(name, None)
+                self.row_name.pop(row, None)
+                self.pending_rows.discard(row)
+                self.hydrating_rows.discard(row)
+                self._payload_blocked.pop(row, None)
+                self._stall_since[row] = -1
+                self._stall_slot[row] = -1
+                self._needs_state.discard(row)
+                self.queues.pop(row, None)
+                self.pending_exec.pop(row, None)
+            # page the records out of RAM as one sequential append run
+            if hasattr(self.paused, "demote_batch"):
+                self.paused.demote_batch(keys)
+            elif hasattr(self.paused, "demote"):
+                for key in keys:
+                    self.paused.demote(key)
+            return len(jobs)
+
+    def restore_batch(self, names: List[str]) -> int:
+        """Wake MANY hibernated names via :meth:`resume_group_batch` —
+        one fused device update for the whole burst, with the spilled
+        records faulted in by sorted sequential segment reads.  Rows are
+        the same deterministic ``default_row_for`` probe the per-name
+        :meth:`restore` uses (intra-batch collisions probe onward).
+        Returns how many names are awake afterward."""
+        n_awake = 0
+        items: List[Tuple[str, int, List[int], int, bool]] = []
+        with self._state_lock:
+            keys = []
+            for name in names:
+                if self.names.get(name) is not None:
+                    n_awake += 1  # already awake
+                    continue
+                eps = self._paused_by_name.get(name)
+                if eps:
+                    keys.append((name, max(eps)))
+            # fault the whole burst's records in sequentially, then read
+            # the member sets the wake needs
+            recs = (
+                self.paused.restore_batch(keys)
+                if hasattr(self.paused, "restore_batch")
+                else {k: self.paused[k] for k in keys if k in self.paused}
+            )
+            import zlib
+
+            G = self.cfg.n_groups
+            claimed: set = set()
+            for name, epoch in keys:
+                rec = recs.get((name, epoch))
+                members = rec.get("members") if rec else None
+                if not members:
+                    continue
+                row = zlib.crc32(name.encode("utf-8")) % G
+                for _ in range(G):
+                    if row not in self.row_name and row not in claimed:
+                        break
+                    row = (row + 1) % G
+                else:
+                    break  # capacity exhausted: stop staging wakes
+                claimed.add(row)
+                items.append((name, epoch, members, row, False))
+        if items:
+            res = self.resume_group_batch(items)
+            n_awake += sum(1 for ok in res.values() if ok)
+        return n_awake
+
     def restore(self, name: str) -> bool:
         """Wake a hibernated instance: roll back to its journaled
         snapshot at a locally chosen row.  Row choice is the same
@@ -1632,7 +1982,9 @@ class PaxosManager:
         with self._state_lock:
             if self.names.get(name) is not None:
                 return True  # already awake
-            epochs = [int(e) for (n, e) in self.paused if n == name]
+            # the by-name mirror, NOT a key scan: the paused table is the
+            # cold tail (millions of names at density scale)
+            epochs = self._paused_by_name.get(name)
         if not epochs:
             return False
         epoch = max(epochs)
@@ -1705,10 +2057,7 @@ class PaxosManager:
 
     def drop_pause_record(self, name: str, epoch: int) -> None:
         with self._state_lock:
-            try:
-                del self.paused[(name, int(epoch))]
-            except KeyError:
-                pass
+            self._paused_pop((name, int(epoch)))
 
     def dedup_for_name(self, name: str) -> Dict[str, list]:
         """This name's exactly-once entries, for shipping WITH any app
@@ -1758,6 +2107,79 @@ class PaxosManager:
                     continue
                 if self.row_activity[row] < cut:
                     out.append((name, int(versions[row])))
+        return out
+
+    def eviction_candidates(
+        self, idle_s: float, limit: Optional[int] = None,
+    ) -> List[Tuple[str, int]]:
+        """Admission-aware pause-eviction order for the idle sweeper:
+        ``idle_names`` filtered and SORTED coldest-first — last-use wall
+        time ascending, cumulative group heat (PR-18 telemetry) as the
+        tiebreak — so a capped sweep (``limit``) always takes the truly
+        cold tail and a name with queued admissions, undrained
+        executions, an in-flight hydration, or recent traffic is never
+        paused ahead of a colder one.  Names resumed within
+        ``PAUSE_EVICTION_HYSTERESIS_S`` are exempt (pause/resume flap
+        protection for a rotating hot set)."""
+        now = time.time()
+        cut = now - idle_s
+        hyst = Config.get_float(PC.PAUSE_EVICTION_HYSTERESIS_S)
+        scored = []
+        with self._state_lock:
+            versions = self._np("version")
+            stopped = self._np("stopped")
+            # prune the hysteresis ledger so it stays bounded by the
+            # names that actually resumed recently
+            for nm in [
+                n for n, t in self._resumed_at.items() if now - t > hyst
+            ]:
+                del self._resumed_at[nm]
+            for name, row in self.names.items():
+                if row in self.pending_rows or self.queues.get(row):
+                    continue  # queued admissions: definitionally not idle
+                if self.pending_exec.get(row) or row in self.hydrating_rows:
+                    continue  # undrained work / snapshot would be blank
+                if int(stopped[row]):
+                    continue  # the delete/upgrade path owns stopping rows
+                if self.row_activity[row] >= cut:
+                    continue
+                t_res = self._resumed_at.get(name)
+                if t_res is not None and now - t_res < hyst:
+                    continue
+                scored.append((
+                    float(self.row_activity[row]),
+                    int(self._heat_host[row]),
+                    name, int(versions[row]),
+                ))
+        scored.sort(key=lambda s: (s[0], s[1]))
+        if limit is not None:
+            scored = scored[: max(0, int(limit))]
+        return [(name, ep) for _t, _h, name, ep in scored]
+
+    def residency_stats(self) -> Dict:
+        """The ``stats`` admin op's ``residency`` block: where every name
+        lives (engine rows vs paused-in-RAM vs paused-on-disk) plus the
+        spill store's internals — and the gauge refresh for the
+        ``paused_in_memory`` / ``paused_on_disk`` metrics (stats-cadence,
+        like the group-heat pull)."""
+        with self._state_lock:
+            paused = self.paused
+            in_mem = int(getattr(paused, "n_in_memory", len(paused)))
+            on_disk = int(getattr(paused, "n_on_disk", 0))
+            out = {
+                "active_names": len(self.names),
+                "pending_rows": len(self.pending_rows),
+                "paused_names": len(paused),
+                "paused_in_memory": in_mem,
+                "paused_on_disk": on_disk,
+                "hysteresis_tracked": len(self._resumed_at),
+                "store": (
+                    paused.stats() if hasattr(paused, "stats")
+                    else {"kind": "dict", "in_memory": in_mem, "on_disk": 0}
+                ),
+            }
+        self.metrics.gauge("paused_in_memory", in_mem)
+        self.metrics.gauge("paused_on_disk", on_disk)
         return out
 
     def get_replica_group(self, name: str) -> Optional[List[int]]:

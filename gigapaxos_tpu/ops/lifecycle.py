@@ -160,6 +160,44 @@ def jump_rows(
     )
 
 
+def restore_paused_rows(
+    state: EngineState,
+    idx: jnp.ndarray,        # [N] rows JUST created by create_groups
+    exec_slot: jnp.ndarray,  # [N] record frontier
+    bal: jnp.ndarray,        # [N] host-computed max(initial ballot, record)
+    app_hash: jnp.ndarray,   # [N]
+    n_execd: jnp.ndarray,    # [N]
+    acc_bal: jnp.ndarray,    # [N, W] window remnants (NULL where empty)
+    acc_vid: jnp.ndarray,    # [N, W]
+    acc_slot: jnp.ndarray,   # [N, W]
+    dec_vid: jnp.ndarray,    # [N, W]
+    dec_slot: jnp.ndarray,   # [N, W]
+) -> EngineState:
+    """Batched unpause: scatter N pause records' consensus remnants over
+    freshly created rows — ONE ``.at[idx].set`` per touched leaf instead
+    of a per-name host round-trip of every leaf (the density campaign's
+    wake-burst path; the old per-name install copied the WHOLE state to
+    host and back per resumed name).  The rows must come straight from
+    :func:`create_groups` (window lanes NULL, ballot at the initial
+    (0, coord0)); the caller computes ``bal`` host-side as the max of
+    that initial ballot and the record's promise, which is exactly the
+    per-name restore's ``max(bal0, rec.bal)``."""
+    idx = jnp.asarray(idx, jnp.int32)
+    as32 = lambda a: jnp.asarray(a, jnp.int32)
+    return state._replace(
+        exec_slot=state.exec_slot.at[idx].set(as32(exec_slot)),
+        bal=state.bal.at[idx].set(as32(bal)),
+        app_hash=state.app_hash.at[idx].set(as32(app_hash)),
+        n_execd=state.n_execd.at[idx].set(as32(n_execd)),
+        c_next_slot=state.c_next_slot.at[idx].set(as32(exec_slot)),
+        acc_bal=state.acc_bal.at[idx].set(as32(acc_bal)),
+        acc_vid=state.acc_vid.at[idx].set(as32(acc_vid)),
+        acc_slot=state.acc_slot.at[idx].set(as32(acc_slot)),
+        dec_vid=state.dec_vid.at[idx].set(as32(dec_vid)),
+        dec_slot=state.dec_slot.at[idx].set(as32(dec_slot)),
+    )
+
+
 def extract_rows(state: EngineState, idx) -> Tuple:
     """Gather full rows for pause-to-disk (HotRestoreInfo analog)."""
     idx = jnp.asarray(idx, jnp.int32)

@@ -171,6 +171,16 @@ class PC(FlagEnum):
     PAUSE_OPTION = True
     DEACTIVATION_PERIOD_S = 60.0
     PAUSE_BATCH_SIZE = 1000
+    # a just-resumed name is exempt from eviction for this long
+    # (hysteresis against pause/resume flap under a rotating hot set)
+    PAUSE_EVICTION_HYSTERESIS_S = 30.0
+    # paused-table spill backend: packed segment files (utils/
+    # packedstore.py — bounded inodes, sequential wake reads) vs the
+    # file-per-key DiskMap fallback
+    PACKED_SPILL = True
+    SPILL_SEGMENT_BYTES = 4 * 1024 * 1024
+    SPILL_COMPACT_RATIO = 0.5
+    SPILL_SUBDIRS = 64
 
     # ---- request handling ---------------------------------------------
     REQUEST_TIMEOUT_S = 8.0              # client callback GC (ref: PaxosClientAsync 8s)

@@ -298,7 +298,14 @@ class ActiveReplica:
                       "epoch_probe", body)
         if not self.pause_option:
             return
-        for name, epoch in self.coordinator.idle_groups(period):
+        # admission-aware eviction order (group-heat telemetry): the
+        # sweep is CAPPED per period (PAUSE_BATCH_SIZE — the reference's
+        # batched Deactivator), so ordering decides who sleeps — the
+        # coldest names go first, and a name with queued admissions or a
+        # recent resume is never suggested ahead of a truly cold one
+        for name, epoch in self.coordinator.eviction_candidates(
+            period, limit=Config.get_int(PC.PAUSE_BATCH_SIZE)
+        ):
             rc = self.rc_ids[hash(name) % len(self.rc_ids)]
             self.send(("RC", rc), "suggest_pause", {
                 "name": name, "epoch": epoch, "from": self.my_id,

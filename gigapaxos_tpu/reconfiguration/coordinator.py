@@ -83,6 +83,14 @@ class AbstractReplicaCoordinator:
         """(name, epoch) pairs idle long enough for a Deactivator sweep."""
         raise NotImplementedError
 
+    def eviction_candidates(self, idle_s: float, limit=None):
+        """Admission-aware sweep order: idle_groups sorted coldest-first
+        (and capped), hot/queued names excluded.  Default: the unsorted
+        idle set truncated — coordinators without heat telemetry still
+        honor the cap."""
+        out = list(self.idle_groups(idle_s))
+        return out if limit is None else out[: max(0, int(limit))]
+
     def pause_record_keys(self):
         """(name, epoch) of locally held pause records (probe targets)."""
         return []
@@ -229,6 +237,9 @@ class PaxosReplicaCoordinator(AbstractReplicaCoordinator):
 
     def idle_groups(self, idle_s: float):
         return self.manager.idle_names(idle_s)
+
+    def eviction_candidates(self, idle_s: float, limit=None):
+        return self.manager.eviction_candidates(idle_s, limit=limit)
 
     def pause_record_keys(self):
         return self.manager.pause_record_keys()

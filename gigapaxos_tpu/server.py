@@ -628,6 +628,9 @@ class PaxosServer:
             # reachable wherever the binary protocol is.  Layered roles
             # (ReconfiguratorServer) ride their own plane stats along
             # (placement loads, probe RTTs) via _layer_stats.
+            # refresh the residency gauges FIRST so the metrics snapshot
+            # inside the engine block already carries this call's values
+            residency = self.manager.residency_stats()
             out = {
                 "op": op, "name": body.get("name"), "ok": True,
                 "tick": self._tick,
@@ -659,6 +662,10 @@ class PaxosServer:
                     "compile": self.manager.engine_compile_stats(),
                     "heat": self._heat_stats(),
                 },
+                # residency plane: engine rows vs paused-in-RAM vs
+                # paused-on-disk (+ the spill store's segment/compaction
+                # internals) — the density campaign's operator view
+                "residency": residency,
                 "profiler": DelayProfiler.get_snapshot(),
                 "profiler_line": DelayProfiler.get_stats(),
             }

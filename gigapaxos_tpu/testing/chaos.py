@@ -1019,3 +1019,184 @@ def run_txn_soak(
         Config.clear()
         if tmp:
             shutil.rmtree(tmp, ignore_errors=True)
+
+
+def run_density_soak(
+    seed: int,
+    *,
+    rounds: int = 120,
+    n_names: int = 96,
+    rows: int = 48,
+) -> Dict:
+    """Seeded residency-plane soak: randomized pause/resume churn over a
+    name population LARGER than the engine (``n_names > rows``), through
+    both the per-name and the batched paths, with the packed spill store
+    squeezed hard (tiny RAM capacity + tiny segments, so the LRU spill,
+    segment rotation, and dead-ratio compaction all fire mid-soak).
+
+    The invariant is the residency plane's whole contract: a name's app
+    state survives ANY interleaving of hibernate/restore (batched or
+    per-name, quiescent or with requests still in flight) with no loss
+    and no double-execution — at the end every name's adder total must
+    equal exactly the sum of everything proposed to it.  Bookkeeping
+    must also stay conserved every round (awake + paused == n_names,
+    RAM + disk == paused) and eviction candidates must never name a row
+    with queued work.  Violations raise :class:`SoakDivergence`.
+    """
+    import numpy as np
+
+    from ..manager import PaxosManager
+    from ..models import StatefulAdderApp
+
+    def ticks(m, n=3):
+        for _ in range(n):
+            vec, _st = m.publish_snapshot()
+            m.tick_host(np.stack([vec]), np.array([True]))
+
+    tmp = tempfile.mkdtemp(prefix="gp_density_soak_")
+    m = None
+    try:
+        # squeeze the store: RAM tier of 8 records, 4 KiB segments, an
+        # eager compactor — every mechanism fires inside a 2-minute soak
+        Config.set("PACKED_SPILL", "true")
+        Config.set("PAUSE_BATCH_SIZE", "2")  # store capacity = 4x this
+        Config.set("SPILL_SEGMENT_BYTES", "4096")
+        Config.set("SPILL_COMPACT_RATIO", "0.3")
+        Config.set("PAUSE_EVICTION_HYSTERESIS_S", "0.0")
+
+        rng = random.Random(seed)
+        cfg = EngineConfig(n_groups=rows, window=8, req_lanes=4,
+                           n_replicas=1)
+        m = PaxosManager(0, StatefulAdderApp(), cfg, log_dir=tmp,
+                         checkpoint_every=10 ** 9, sync_journal=False)
+        names = [f"d{i:03d}" for i in range(n_names)]
+        # boot: everything created, then the overflow put to sleep so the
+        # population exceeds the engine from round 0
+        for lo in range(0, n_names, rows):
+            chunk = names[lo:lo + rows]
+            m.create_paxos_batch(chunk, [0])
+            if lo + len(chunk) < n_names:
+                m.hibernate_batch(chunk)
+        vals: Dict[str, List[int]] = {nm: [] for nm in names}
+        replies: List[Tuple[str, str]] = []
+
+        def awake():
+            return [nm for nm in names if nm in m.names]
+
+        def asleep():
+            return [nm for nm in names if nm not in m.names]
+
+        for rnd in range(rounds):
+            op = rng.random()
+            if op < 0.40:  # traffic on a random awake name
+                pool = awake()
+                if pool:
+                    nm = rng.choice(pool)
+                    v = rng.randrange(1, 100)
+                    vals[nm].append(v)
+                    m.propose(nm, str(v),
+                              callback=lambda _r, rep, nm=nm:
+                              replies.append((nm, rep)))
+                    if rng.random() < 0.3:
+                        # leave it IN FLIGHT: the next hibernate of this
+                        # name must carry the request (held vid / window
+                        # remnant), not lose it
+                        continue
+                    ticks(m, 3)
+            elif op < 0.60:  # batched sleep of a random awake subset
+                pool = awake()
+                if pool:
+                    k = min(len(pool), rng.randrange(1, 9))
+                    m.hibernate_batch(rng.sample(pool, k))
+            elif op < 0.80:  # batched wake of a random asleep subset
+                pool = asleep()
+                free = rows - len(m.names)
+                if pool and free > 0:
+                    k = min(len(pool), free, rng.randrange(1, 9))
+                    m.restore_batch(rng.sample(pool, k))
+                    ticks(m, 2)  # re-proposed held vids decide
+            elif op < 0.90:  # the N=1 parity path
+                pool = asleep()
+                if pool and len(m.names) < rows:
+                    m.restore(rng.choice(pool))
+                pool = awake()
+                if pool:
+                    m.hibernate(rng.choice(pool))
+            else:
+                ticks(m, 2)
+            if rnd % 10 == 9:
+                res = m.residency_stats()
+                if res["active_names"] + res["paused_names"] != n_names:
+                    raise SoakDivergence(
+                        "name conservation breach", {"round": rnd, **{
+                            k: res[k] for k in
+                            ("active_names", "paused_names")}})
+                if (res["paused_in_memory"] + res["paused_on_disk"]
+                        != res["paused_names"]):
+                    raise SoakDivergence(
+                        "paused tier accounting breach",
+                        {"round": rnd, **{k: res[k] for k in
+                         ("paused_names", "paused_in_memory",
+                          "paused_on_disk")}})
+                for nm, _e in m.eviction_candidates(idle_s=0.0):
+                    row = m.names.get(nm)
+                    if row is not None and m.queues.get(row):
+                        raise SoakDivergence(
+                            "eviction candidate has queued work",
+                            {"round": rnd, "name": nm})
+
+        # final audit: wake everyone in waves (population > rows), drain,
+        # and demand exact totals
+        expected = {nm: sum(vs) for nm, vs in vals.items()}
+        unchecked = list(names)
+        waves = 0
+        while unchecked:
+            waves += 1
+            if waves > 4 * (n_names // rows + 2):
+                raise SoakDivergence(
+                    "final audit did not converge",
+                    {"unchecked": unchecked[:8]})
+            wave = unchecked[:rows]
+            m.restore_batch([nm for nm in wave if nm not in m.names])
+            for _ in range(30):
+                ticks(m, 2)
+                if all(m.app.totals.get(nm, 0) == expected[nm]
+                       for nm in wave):
+                    break
+            bad = {nm: {"have": m.app.totals.get(nm, 0),
+                        "want": expected[nm]}
+                   for nm in wave
+                   if m.app.totals.get(nm, 0) != expected[nm]}
+            if bad:
+                raise SoakDivergence(
+                    "adder totals diverged from proposed history "
+                    "(lost or double-executed request across a "
+                    "pause/resume interleaving)",
+                    {"seed": seed, "names": dict(list(bad.items())[:8])})
+            m.hibernate_batch(wave)
+            unchecked = unchecked[rows:]
+
+        # every reply that did arrive must be a real prefix sum of that
+        # name's history (exactly-once visible to the client too)
+        for nm, rep in replies:
+            cums, s = set(), 0
+            for v in vals[nm]:
+                s += v
+                cums.add(str(s))
+            if rep not in cums:
+                raise SoakDivergence(
+                    "reply is not a prefix sum of the proposed history",
+                    {"seed": seed, "name": nm, "reply": rep})
+
+        store = m.residency_stats().get("store", {})
+        return {
+            "seed": seed, "rounds": rounds,
+            "replies": len(replies),
+            "compactions": store.get("compactions"),
+            "segments": store.get("segments"),
+        }
+    finally:
+        if m is not None:
+            m.close()
+        Config.clear()
+        shutil.rmtree(tmp, ignore_errors=True)

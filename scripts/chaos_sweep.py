@@ -31,13 +31,14 @@ sys.path.insert(0, ".")
 
 from gigapaxos_tpu.testing.chaos import (  # noqa: E402
     SoakDivergence,
+    run_density_soak,
     run_soak,
     run_txn_soak,
 )
 
 #: stats keys worth carrying into the artifact, per soak flavor
 _STAT_KEYS = ("settle_iters", "txns", "committed", "aborted", "killed",
-              "in_doubt_resolved")
+              "in_doubt_resolved", "replies", "compactions", "segments")
 
 
 def main() -> None:
@@ -53,9 +54,11 @@ def main() -> None:
     ap.add_argument("--dup-rate", type=float, default=0.0)
     ap.add_argument("--family", default="core",
                     help="comma list of soak families to run per seed: "
-                         "core (reconfiguration-plane run_soak) and/or "
+                         "core (reconfiguration-plane run_soak), "
                          "txn (2PC bank-transfer run_txn_soak, its own "
-                         "tuned fault rates)")
+                         "tuned fault rates), and/or density "
+                         "(residency-plane run_density_soak: batched "
+                         "pause/resume churn over a squeezed spill store)")
     ap.add_argument("--out", default="CHAOS_SWEEP_r01.json",
                     help="sweep artifact path ('' disables the write)")
     args = ap.parse_args()
@@ -66,6 +69,7 @@ def main() -> None:
             loss=args.loss, dup_rate=args.dup_rate,
         ),
         "txn": run_txn_soak,
+        "density": run_density_soak,
     }
     families = [f.strip() for f in args.family.split(",") if f.strip()]
     unknown = [f for f in families if f not in runners]

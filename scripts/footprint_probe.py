@@ -140,6 +140,63 @@ def probe_sharded(G: int, W: int, K: int, R: int, n_shards: int) -> dict:
     return out
 
 
+def probe_paused(n_paused: int, state_bytes: int, window: int) -> dict:
+    """Deployment arithmetic for the PAUSED tail (the density campaign's
+    cold names): bytes/name in the packed spill store on disk + index
+    bytes in RAM, measured from real encodings of a representative
+    quiescent pause record — not hand-waved constants — then asserted
+    against a per-paused-name budget (a record-format regression that
+    fans per-name cost out fails this probe, not a 1M-name run)."""
+    import sys as _sys
+
+    from gigapaxos_tpu.utils.packedstore import _HDR, _key_to_wire
+
+    name = "svc0123456"  # representative 10-char service name
+    key = (name, 0)
+    # quiescent record shape (manager._extract_record): no window
+    # remnants, single-member group, app state of the given size
+    rec = {
+        "name": name, "epoch": 0, "exec": 64, "bal": 7,
+        "app_hash": 2 ** 30, "n_execd": 64,
+        "app_state": "x" * max(1, state_bytes),
+        "app_exec": 64, "acc": [], "dec": [], "dedup": {},
+        "members": [0, 1, 2],
+    }
+    payload = json.dumps([_key_to_wire(key), rec]).encode("utf-8")
+    disk_per_name = _HDR.size + len(payload)
+    # RAM tier: the spill index entry (key -> (seg, off, len)) + the
+    # by-name epoch mirror (manager._paused_by_name).  Dict slots cost
+    # ~3 machine words amortized at CPython's 2/3 fill bound.
+    dict_slot = 3 * 8 / (2 / 3)
+    index_per_name = (
+        _sys.getsizeof(key)
+        + _sys.getsizeof(name)
+        + _sys.getsizeof((0, 0, 0))
+        + 3 * _sys.getsizeof(0)
+        + dict_slot  # spill index slot
+        + _sys.getsizeof(name) + _sys.getsizeof({0}) + dict_slot  # mirror
+    )
+    # budget: JSON framing + record scaffolding must stay O(100 B) over
+    # the app state; the RAM index must stay pointer-sized, not
+    # record-sized (the whole point of paging the records out)
+    disk_budget = 640 + 2 * max(1, state_bytes)
+    ram_budget = 1024
+    return {
+        "n_paused": n_paused,
+        "app_state_bytes": state_bytes,
+        "window": window,
+        "disk_bytes_per_name": disk_per_name,
+        "disk_budget_bytes_per_name": disk_budget,
+        "index_ram_bytes_per_name": round(index_per_name, 1),
+        "index_ram_budget_bytes_per_name": ram_budget,
+        "paused_tail_disk_bytes": n_paused * disk_per_name,
+        "paused_tail_index_ram_bytes": round(n_paused * index_per_name),
+        "within_budget": (
+            disk_per_name <= disk_budget and index_per_name <= ram_budget
+        ),
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--groups", "-G", type=int, default=1_048_576)
@@ -153,6 +210,13 @@ def main() -> int:
                     metavar="N",
                     help="device-resident I/O ring bytes at "
                          "ENGINE_STEPS_PER_DISPATCH=N")
+    ap.add_argument("--paused", type=int, default=0, metavar="N",
+                    help="add paused-tail arithmetic for N paused names "
+                         "(packed spill store) and assert the "
+                         "per-paused-name disk/RAM budgets")
+    ap.add_argument("--paused-state-bytes", type=int, default=64,
+                    help="representative app-state size inside the "
+                         "pause record for --paused")
     args = ap.parse_args()
     out = probe(args.groups, args.window, args.req_lanes, args.replicas)
     out["device_queue"] = device_queue(
@@ -164,6 +228,10 @@ def main() -> int:
             args.groups, args.window, args.req_lanes, args.replicas,
             args.sharded,
         )
+    if args.paused > 0:
+        out["paused"] = probe_paused(
+            args.paused, args.paused_state_bytes, args.window,
+        )
     print(json.dumps(out))
     if args.sharded > 0 and not out["sharded"]["within_budget"]:
         print(
@@ -171,6 +239,17 @@ def main() -> int:
             f"{out['sharded']['per_device_blob_bytes_per_group']} B/group "
             f"> {out['sharded']['compact_budget_bytes_per_group']} B/group "
             f"compact-blob budget at {args.sharded} shards",
+            file=sys.stderr,
+        )
+        return 1
+    if args.paused > 0 and not out["paused"]["within_budget"]:
+        p = out["paused"]
+        print(
+            f"PAUSED-TAIL BUDGET EXCEEDED: disk "
+            f"{p['disk_bytes_per_name']} B/name (budget "
+            f"{p['disk_budget_bytes_per_name']}) / index RAM "
+            f"{p['index_ram_bytes_per_name']} B/name (budget "
+            f"{p['index_ram_budget_bytes_per_name']})",
             file=sys.stderr,
         )
         return 1
